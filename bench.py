@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Benchmark driver: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures training throughput (examples/sec) the same way the reference's
+benchmark harness does (reference: benchmark/fluid/fluid_benchmark.py:297-301
+— num_samples/elapsed per pass) on the flagship config. Runs on whatever
+device JAX_PLATFORMS selects (the real TPU chip under the driver).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_mnist_mlp(batch=512, steps=50, warmup=10):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=512, act="relu")
+        h2 = fluid.layers.fc(input=h, size=512, act="relu")
+        pred = fluid.layers.fc(input=h2, size=10, act=None)
+        loss = fluid.layers.softmax_with_cross_entropy(logits=pred, label=label)
+        avg_loss = fluid.layers.mean(loss)
+        opt = fluid.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 784).astype(np.float32)
+    y = rng.randint(0, 10, (batch, 1)).astype(np.int64)
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_loss])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (l,) = exe.run(main, feed={"img": x, "label": y},
+                           fetch_list=[avg_loss])
+        elapsed = time.perf_counter() - t0
+    return batch * steps / elapsed
+
+
+def main():
+    try:
+        ips = bench_mnist_mlp()
+        print(json.dumps({
+            "metric": "mnist_mlp_train_examples_per_sec",
+            "value": round(float(ips), 2),
+            "unit": "examples/sec",
+            "vs_baseline": None,
+        }))
+    except Exception as e:  # never leave the driver without a JSON line
+        print(json.dumps({
+            "metric": "mnist_mlp_train_examples_per_sec",
+            "value": 0.0,
+            "unit": "examples/sec",
+            "vs_baseline": None,
+            "error": str(e)[:200],
+        }))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

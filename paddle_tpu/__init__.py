@@ -1,0 +1,15 @@
+"""paddle_tpu — a TPU-native deep learning framework with the capabilities of
+PaddlePaddle Fluid (reference: xiaolil1/Paddle).
+
+Architecture (not a port): Fluid's declarative Program/Block/Op model is kept
+as the user-facing IR (reference: paddle/fluid/framework/framework.proto:24-188),
+but execution is whole-program lowering to JAX/XLA on PJRT instead of a per-op
+kernel interpreter (reference: paddle/fluid/framework/executor.cc:397-456).
+Data parallelism is SPMD over a `jax.sharding.Mesh` with compiled XLA
+collectives over ICI (replacing NCCL op-handles,
+reference: paddle/fluid/framework/details/all_reduce_op_handle.cc).
+"""
+
+from paddle_tpu import fluid  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+from paddle_tpu.core.types import VarDesc, convert_np_dtype_to_dtype_  # noqa: F401
+from paddle_tpu.core.desc import (  # noqa: F401
+    OpDesc,
+    VarDescData,
+    BlockDescData,
+    ProgramDescData,
+)
+from paddle_tpu.core.registry import (  # noqa: F401
+    OpRegistry,
+    register_op,
+    LowerContext,
+)
+from paddle_tpu.core.scope import Scope  # noqa: F401

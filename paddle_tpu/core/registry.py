@@ -1,0 +1,132 @@
+"""Operator registry: per-op shape inference, JAX lowering, and grad makers.
+
+This replaces the reference's kernel-oriented registry
+(reference: paddle/fluid/framework/op_registry.h:197 REGISTER_OPERATOR +
+REGISTER_OP_CPU_KERNEL/_CUDA_KERNEL) with a lowering-oriented one: an op is
+registered with
+
+  * ``infer_shape(ctx)``  — static shape/dtype propagation at build time,
+  * ``lower(ctx, ins, attrs)`` — emits jax/jnp computation when the whole
+    block is traced to one XLA executable (the nGraph-bridge seam,
+    reference: paddle/fluid/operators/ngraph/ngraph_engine.cc:64-128,
+    generalized to the whole block),
+  * optionally a custom grad maker; by default gradients are derived
+    automatically from the forward lowering via ``jax.vjp`` — the TPU-native
+    answer to the reference's hand-written per-op grad kernels.
+"""
+
+
+class OpInfo:
+    def __init__(self, type):
+        self.type = type
+        self.infer_shape = None
+        self.lower = None
+        # grad_maker(op, block, no_grad_set) -> list[OpDesc-args tuples]
+        self.grad_maker = "default"  # "default" | None | callable
+        # For *_grad ops: which forward op type they differentiate.
+        self.forward_type = None
+        # Inputs that never receive gradient (e.g. integer id tensors).
+        self.no_grad_inputs = frozenset()
+        # Whether lowering needs an RNG key (dropout, random init ops).
+        self.needs_rng = False
+        # Stateful-output slots that alias an input slot (in-place semantics
+        # of the reference's optimizer ops, e.g. ParamOut aliases Param).
+        self.inplace_map = {}
+
+
+class OpRegistry:
+    _ops = {}
+
+    @classmethod
+    def register(cls, info):
+        cls._ops[info.type] = info
+
+    @classmethod
+    def get(cls, type):
+        if type not in cls._ops:
+            raise KeyError("Operator %r is not registered" % type)
+        return cls._ops[type]
+
+    @classmethod
+    def has(cls, type):
+        return type in cls._ops
+
+    @classmethod
+    def all_types(cls):
+        return sorted(cls._ops)
+
+
+def register_op(
+    type,
+    grad=None,
+    no_grad_inputs=(),
+    needs_rng=False,
+    inplace_map=None,
+    infer_shape=None,
+):
+    """Decorator registering ``fn`` as the JAX lowering of op ``type``.
+
+    ``fn(ctx, ins, attrs) -> dict[slot, list[jax array]]`` where ``ins`` maps
+    input slot name -> list of jax arrays (missing slots -> empty list).
+
+    grad: "default" (auto-vjp), None (non-differentiable), or a callable
+    custom grad maker.
+    """
+
+    def deco(fn):
+        info = OpInfo(type)
+        info.lower = fn
+        info.grad_maker = grad if grad is not None else "default"
+        info.no_grad_inputs = frozenset(no_grad_inputs)
+        info.needs_rng = needs_rng
+        info.inplace_map = dict(inplace_map or {})
+        info.infer_shape = infer_shape
+        OpRegistry.register(info)
+        return fn
+
+    return deco
+
+
+def register_no_grad_op(type, **kwargs):
+    """Op whose inputs never get gradients (metrics, casts to int, IO...)."""
+
+    def deco(fn):
+        info = OpInfo(type)
+        info.lower = fn
+        info.grad_maker = None
+        info.needs_rng = kwargs.get("needs_rng", False)
+        info.inplace_map = dict(kwargs.get("inplace_map") or {})
+        OpRegistry.register(info)
+        return fn
+
+    return deco
+
+
+class LowerContext:
+    """Per-op context handed to lowerings during block tracing."""
+
+    def __init__(self, op, block, rng_key=None, op_index=0, is_test=False,
+                 executor=None):
+        self.op = op
+        self.block = block
+        self._rng_key = rng_key
+        self.op_index = op_index
+        self.is_test = is_test
+        self.executor = executor  # engine, for ops needing sub-block runs
+
+    def attr(self, name, default=None):
+        return self.op.attrs.get(name, default)
+
+    def var_desc(self, name):
+        return self.block.find_var_recursive(name)
+
+    def rng(self):
+        """A PRNG key unique to this op instance within the step."""
+        import jax
+
+        if self._rng_key is None:
+            raise RuntimeError(
+                "Op %s needs RNG but block was lowered without a key"
+                % self.op.type
+            )
+        return jax.random.fold_in(self._rng_key, self.op_index)

@@ -1,0 +1,68 @@
+"""``fluid.core`` shim: the names scripts reach through the pybind module
+(reference: paddle/fluid/pybind/pybind.cc PYBIND11_MODULE(core)). The TPU
+build's control plane is Python over JAX, so this is a thin façade."""
+
+import numpy as np
+
+from paddle_tpu.core.scope import Scope  # noqa: F401
+from paddle_tpu.core.types import VarDesc, VarType  # noqa: F401
+from paddle_tpu.platform import (  # noqa: F401
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+
+
+class LoDTensor:
+    """Host-side tensor + LoD offsets, for feed/fetch compatibility
+    (reference: lod_tensor.h:110). On TPU the LoD is carried alongside a
+    padded dense array."""
+
+    def __init__(self):
+        self._array = None
+        self._lod = []
+
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def set_lod(self, lod):
+        self._lod = lod
+
+    def lod(self):
+        return self._lod
+
+    def recursive_sequence_lengths(self):
+        return [
+            [e - s for s, e in zip(level[:-1], level[1:])] for level in self._lod
+        ]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = []
+        for level in lengths:
+            offsets = [0]
+            for l in level:
+                offsets.append(offsets[-1] + l)
+            self._lod.append(offsets)
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._array, dtype=dtype)
+
+    def shape(self):
+        return list(self._array.shape)
+
+
+def create_lod_tensor(data, recursive_seq_lens=None, place=None):
+    t = LoDTensor()
+    t.set(data, place)
+    if recursive_seq_lens:
+        t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t
+
+
+def get_cuda_device_count():
+    from paddle_tpu.platform import cuda_device_count
+
+    return cuda_device_count()

@@ -1,0 +1,47 @@
+"""DataFeeder (reference: python/paddle/fluid/data_feeder.py) — converts
+python/numpy minibatch rows into the feed dict. The reference builds
+LoDTensors; here ragged int sequences become padded arrays + implicit
+lengths (the TPU-native LoD equivalent)."""
+
+import numpy as np
+
+from paddle_tpu.core.types import convert_dtype_to_np
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place, program=None):
+        self.feed_names = []
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                from paddle_tpu.framework import default_main_program
+
+                v = (program or default_main_program()).global_block().var(v)
+            self.feed_vars.append(v)
+            self.feed_names.append(v.name)
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of rows, each row a tuple matching feed_list."""
+        columns = list(zip(*iterable))
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            dtype = convert_dtype_to_np(var.dtype)
+            arrs = [np.asarray(x, dtype=dtype) for x in col]
+            shapes = {a.shape for a in arrs}
+            if len(shapes) == 1:
+                batch = np.stack(arrs)
+            else:
+                # ragged: right-pad to max length on axis 0
+                maxlen = max(a.shape[0] for a in arrs)
+                trail = arrs[0].shape[1:]
+                batch = np.zeros((len(arrs), maxlen) + trail, dtype=dtype)
+                for i, a in enumerate(arrs):
+                    batch[i, : a.shape[0]] = a
+            shape = var.shape
+            if shape is not None and len(shape) == len(batch.shape) + 1:
+                # declared shape has a trailing 1 (e.g. labels [N,1])
+                if shape[-1] == 1:
+                    batch = batch[..., None]
+            out[var.name] = batch
+        return out

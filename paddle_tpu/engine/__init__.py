@@ -1,0 +1,1 @@
+from paddle_tpu.engine.executor import Engine  # noqa: F401

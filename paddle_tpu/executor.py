@@ -1,0 +1,81 @@
+"""Executor — the user-facing run loop (reference:
+python/paddle/fluid/executor.py — Executor:262, run:451, program cache +
+feed/fetch injection :319-363). Dispatches whole blocks to the XLA engine;
+CompiledProgram runs go through the SPMD path (compiler.py)."""
+
+import numpy as np
+
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.engine.executor import Engine
+from paddle_tpu.framework import Program, default_main_program
+from paddle_tpu.platform import CPUPlace, default_accelerator_place
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return _guard()
+
+
+def _as_feed_dict(feed):
+    if feed is None:
+        return {}
+    if isinstance(feed, dict):
+        return {k: np.asarray(v) for k, v in feed.items()}
+    raise TypeError("feed must be a dict of name -> ndarray")
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place if place is not None else default_accelerator_place()
+        self.engine = Engine(self.place)
+
+    def close(self):
+        """Graceful shutdown (reference: executor.py close — notifies
+        pservers). Engine caches are dropped."""
+        self.engine._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
+            fetch_var_name="fetch", scope=None, return_numpy=True,
+            use_program_cache=True):
+        from paddle_tpu.compiler import CompiledProgram
+
+        scope = scope if scope is not None else global_scope()
+        fetch_list = fetch_list or []
+
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+
+        if program is None:
+            program = default_main_program()
+
+        feed = _as_feed_dict(feed)
+        fetch_names = [
+            f.name if hasattr(f, "name") else str(f) for f in fetch_list
+        ]
+        return self.engine.run_block(
+            program.desc,
+            0,
+            scope,
+            feed=feed,
+            fetch_list=fetch_names,
+            is_test=getattr(program, "_is_test", False),
+            return_numpy=return_numpy,
+            seed=getattr(program, "random_seed", 0) or 0,
+        )

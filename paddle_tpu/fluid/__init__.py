@@ -1,0 +1,66 @@
+"""``paddle_tpu.fluid`` — the Fluid-compatible namespace.
+
+Lets reference-era scripts switch with one import line:
+``import paddle_tpu.fluid as fluid``
+(reference API surface: python/paddle/fluid/__init__.py).
+"""
+
+from paddle_tpu import ops as _ops  # noqa: F401  (registers all lowerings)
+from paddle_tpu import layers  # noqa: F401
+from paddle_tpu import initializer  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import regularizer  # noqa: F401
+from paddle_tpu import clip  # noqa: F401
+from paddle_tpu import unique_name  # noqa: F401
+from paddle_tpu import metrics  # noqa: F401
+from paddle_tpu import profiler  # noqa: F401
+
+from paddle_tpu.framework import (  # noqa: F401
+    Program,
+    Variable,
+    Operator,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+)
+from paddle_tpu.executor import Executor, global_scope, scope_guard  # noqa: F401
+from paddle_tpu.core.scope import Scope  # noqa: F401
+from paddle_tpu.platform import (  # noqa: F401
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from paddle_tpu.backward import append_backward, calc_gradient  # noqa: F401
+from paddle_tpu.data_feeder import DataFeeder  # noqa: F401
+from paddle_tpu.compiler import CompiledProgram  # noqa: F401
+from paddle_tpu.parallel_executor import (  # noqa: F401
+    ParallelExecutor,
+    ExecutionStrategy,
+    BuildStrategy,
+)
+from paddle_tpu import io  # noqa: F401
+from paddle_tpu.io import (  # noqa: F401
+    save_params,
+    save_persistables,
+    load_params,
+    load_persistables,
+    save_inference_model,
+    load_inference_model,
+)
+from paddle_tpu import core_shim as core  # noqa: F401
+
+# default_startup_program must be importable as fluid.default_startup_program
+__all__ = [
+    "layers", "initializer", "optimizer", "regularizer", "clip",
+    "Program", "Variable", "Operator", "program_guard",
+    "default_main_program", "default_startup_program",
+    "Executor", "global_scope", "scope_guard", "Scope",
+    "CPUPlace", "TPUPlace", "CUDAPlace", "ParamAttr",
+    "append_backward", "DataFeeder", "CompiledProgram", "ParallelExecutor",
+    "io", "core", "metrics", "profiler",
+]

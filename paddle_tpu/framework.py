@@ -1,0 +1,485 @@
+"""Program/Block/Operator/Variable — the user-facing graph-building API.
+
+Mirrors the reference's python/paddle/fluid/framework.py (Variable:242,
+Operator:565, Block:1014, Program:1880) over the paddle_tpu.core descriptor
+model. Build-time shape/dtype inference is done by abstractly evaluating each
+op's JAX lowering with ``jax.eval_shape`` — one inference engine for all ops
+instead of per-op C++ InferShape (reference: operator.cc:586
+RuntimeInferShapeContext).
+"""
+
+import numpy as np
+
+import jax
+
+from paddle_tpu import unique_name
+from paddle_tpu.core.desc import ProgramDescData
+from paddle_tpu.core.registry import OpRegistry, LowerContext
+from paddle_tpu.core.types import (
+    VarType,
+    convert_np_dtype_to_dtype_,
+    convert_dtype_to_np,
+)
+from paddle_tpu.engine.lowering import clean_attrs
+
+# Dummy size substituted for the -1 batch dim during abstract shape
+# inference; outputs carrying it are mapped back to -1.
+_BATCH_SENTINEL = 1223
+
+
+class Variable:
+    """Symbolic variable in a block (reference: framework.py:242)."""
+
+    def __init__(self, block, name=None, shape=None, dtype="float32",
+                 type=VarType.LOD_TENSOR, persistable=False,
+                 stop_gradient=False, lod_level=0, is_parameter=False,
+                 **kwargs):
+        self.block = block
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        desc = block.desc.create_var(
+            name,
+            shape=shape,
+            dtype=convert_np_dtype_to_dtype_(dtype) if dtype is not None else None,
+            type=type,
+            persistable=persistable,
+            stop_gradient=stop_gradient,
+            lod_level=lod_level,
+            is_parameter=is_parameter,
+        )
+        self.desc = desc
+
+    # -- properties mirroring the reference API ----------------------------
+    @property
+    def name(self):
+        return self.desc.name
+
+    @property
+    def shape(self):
+        return tuple(self.desc.shape) if self.desc.shape is not None else None
+
+    @property
+    def dtype(self):
+        return self.desc.dtype
+
+    @property
+    def persistable(self):
+        return self.desc.persistable
+
+    @persistable.setter
+    def persistable(self, v):
+        self.desc.persistable = v
+
+    @property
+    def stop_gradient(self):
+        return self.desc.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.desc.stop_gradient = v
+
+    @property
+    def lod_level(self):
+        return self.desc.lod_level
+
+    @property
+    def type(self):
+        return self.desc.type
+
+    def numpy_dtype(self):
+        return convert_dtype_to_np(self.desc.dtype)
+
+    def __repr__(self):
+        return "Variable(%s, shape=%s, dtype=%s)" % (
+            self.name,
+            self.shape,
+            getattr(self.dtype, "name", self.dtype),
+        )
+
+    __str__ = __repr__
+
+    # -- operator sugar (subset of reference's monkey-patched math ops) ----
+    def _binary(self, other, op_type, reverse=False):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper(op_type, block=self.block)
+        x, y = (other, self) if reverse else (self, other)
+        out = helper.create_variable_for_type_inference(dtype=self.dtype)
+        helper.append_op(
+            type=op_type,
+            inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]},
+            attrs={"axis": -1},
+        )
+        return out
+
+    def _scale(self, scale=1.0, bias=0.0):
+        """Scalar arithmetic lowers to a `scale` op — shape-agnostic, so it
+        works for vars with a -1 batch dim."""
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("scale", block=self.block)
+        out = helper.create_variable_for_type_inference(dtype=self.dtype)
+        helper.append_op(
+            type="scale",
+            inputs={"X": [self]},
+            outputs={"Out": [out]},
+            attrs={"scale": float(scale), "bias": float(bias),
+                   "bias_after_scale": True},
+        )
+        return out
+
+    def __add__(self, other):
+        if not isinstance(other, Variable):
+            return self._scale(1.0, float(other))
+        return self._binary(other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        if not isinstance(other, Variable):
+            return self._scale(1.0, -float(other))
+        return self._binary(other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        if not isinstance(other, Variable):
+            return self._scale(-1.0, float(other))
+        return self._binary(other, "elementwise_sub", reverse=True)
+
+    def __mul__(self, other):
+        if not isinstance(other, Variable):
+            return self._scale(float(other), 0.0)
+        return self._binary(other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if not isinstance(other, Variable):
+            return self._scale(1.0 / float(other), 0.0)
+        return self._binary(other, "elementwise_div")
+
+    def __neg__(self):
+        return self._scale(-1.0, 0.0)
+
+
+class Parameter(Variable):
+    def __init__(self, block, shape, dtype, **kwargs):
+        self.trainable = kwargs.pop("trainable", True)
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.gradient_clip_attr = kwargs.pop("gradient_clip_attr", None)
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        super().__init__(
+            block,
+            shape=shape,
+            dtype=dtype,
+            persistable=True,
+            is_parameter=True,
+            **kwargs,
+        )
+
+
+class Operator:
+    """Wraps an OpDesc; runs abstract shape inference on creation
+    (reference: framework.py:565 Operator.__init__ calling C++ InferShape)."""
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        in_names = {
+            slot: [v.name if isinstance(v, Variable) else v for v in _as_list(vs)]
+            for slot, vs in (inputs or {}).items()
+        }
+        out_names = {
+            slot: [v.name if isinstance(v, Variable) else v for v in _as_list(vs)]
+            for slot, vs in (outputs or {}).items()
+        }
+        self.desc = block.desc.append_op(type, in_names, out_names, attrs or {})
+        block.program._bump_version()
+        if OpRegistry.has(type) or (
+            type.endswith("_grad") and OpRegistry.has(type[: -len("_grad")])
+        ):
+            try:
+                infer_shapes_for_op(self.desc, block.desc)
+            except Exception:
+                # Shape inference is best-effort at build time; real shapes
+                # are established when tracing (dynamic cases like `range`).
+                pass
+
+    @property
+    def type(self):
+        return self.desc.type
+
+    def attr(self, name):
+        return self.desc.attrs.get(name)
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _abstract_value(var_desc):
+    shape = [
+        _BATCH_SENTINEL if d in (-1, None) else d for d in (var_desc.shape or [])
+    ]
+    dtype = convert_dtype_to_np(var_desc.dtype or VarType.FP32)
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def infer_shapes_for_op(op_desc, block_desc):
+    """Propagate shapes/dtypes through ``op_desc`` by abstract evaluation of
+    its lowering."""
+    op_type = op_desc.type
+    base = op_type[: -len("_grad")] if op_type.endswith("_grad") else op_type
+    info = OpRegistry.get(base)
+    if op_type.endswith("_grad"):
+        # grad of X has X's shape; skip abstract eval
+        for slot, names in op_desc.outputs.items():
+            if not slot.endswith("@GRAD"):
+                continue
+            fwd_slot = slot[: -len("@GRAD")]
+            fwd_names = op_desc.inputs.get(fwd_slot, [])
+            for gname, fname in zip(names, fwd_names):
+                fv = block_desc.find_var_recursive(fname)
+                gv = block_desc.find_var_recursive(gname)
+                if fv is not None and gv is not None:
+                    gv.shape = list(fv.shape) if fv.shape is not None else None
+                    gv.dtype = fv.dtype
+        return
+
+    ins = {}
+    for slot, names in op_desc.inputs.items():
+        vals = []
+        for n in names:
+            vd = block_desc.find_var_recursive(n)
+            if vd is None or vd.shape is None:
+                return  # can't infer
+            vals.append(_abstract_value(vd))
+        ins[slot] = vals
+
+    attrs = clean_attrs(op_desc.attrs)
+
+    def fn(ins_):
+        ctx = LowerContext(op_desc, block_desc,
+                           rng_key=jax.random.PRNGKey(0), op_index=0)
+        return info.lower(ctx, ins_, attrs)
+
+    out_shapes = jax.eval_shape(fn, ins)
+
+    for slot, names in op_desc.outputs.items():
+        shapes = out_shapes.get(slot, [])
+        for i, n in enumerate(names):
+            if i >= len(shapes) or shapes[i] is None:
+                continue
+            vd = block_desc.find_var_recursive(n)
+            if vd is None:
+                continue
+            sh = [(-1 if d == _BATCH_SENTINEL else d) for d in shapes[i].shape]
+            vd.shape = sh
+            vd.dtype = convert_np_dtype_to_dtype_(shapes[i].dtype)
+
+
+class Block:
+    def __init__(self, program, idx):
+        self.program = program
+        self.desc = program.desc.block(idx)
+        self.idx = idx
+        self.vars = {}  # name -> Variable wrapper
+        self.ops = []
+
+    @property
+    def parent_idx(self):
+        return self.desc.parent_idx
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is not None:
+            return v
+        b = self
+        while True:
+            if name in b.vars:
+                return b.vars[name]
+            if b.desc.parent_idx < 0:
+                break
+            b = self.program.blocks[b.desc.parent_idx]
+        raise ValueError("var %r not in this block" % name)
+
+    def has_var(self, name):
+        try:
+            self.var(name)
+            return True
+        except ValueError:
+            return False
+
+    def _var_recursive(self, name):
+        return self.var(name)
+
+    def create_var(self, name=None, **kwargs):
+        v = Variable(self, name=name, **kwargs)
+        self.vars[v.name] = v
+        return v
+
+    def create_parameter(self, name=None, shape=None, dtype="float32", **kwargs):
+        if name is None:
+            name = unique_name.generate("param")
+        p = Parameter(self, shape, dtype, name=name, **kwargs)
+        self.vars[name] = p
+        self.program._parameters.setdefault(name, p)
+        return p
+
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        return op
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+
+class Program:
+    """A whole program (reference: framework.py:1880)."""
+
+    def __init__(self):
+        self.desc = ProgramDescData()
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._parameters = {}
+        self._version = 0
+        self._is_test = False
+        # sync token used by the engine's executable cache
+        self.desc._version_token = 0
+
+    def _bump_version(self):
+        self._version += 1
+        self.desc._version_token = self._version
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def create_block(self, parent_idx=None):
+        parent = (
+            self.current_block_idx if parent_idx is None else parent_idx
+        )
+        bd = self.desc.append_block(parent)
+        b = Block(self, bd.idx)
+        self.blocks.append(b)
+        self.current_block_idx = bd.idx
+        return b
+
+    def rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def all_parameters(self):
+        return list(self._parameters.values())
+
+    def list_vars(self):
+        for b in self.blocks:
+            for v in b.vars.values():
+                yield v
+
+    def clone(self, for_test=False):
+        import copy
+
+        new = Program()
+        new.desc = self.desc.clone()
+        new.desc._version_token = 0
+        new.blocks = [Block.__new__(Block) for _ in self.desc.blocks]
+        for i, b in enumerate(new.blocks):
+            b.program = new
+            b.desc = new.desc.block(i)
+            b.idx = i
+            b.ops = []
+            b.vars = {}
+            old_block = self.blocks[i] if i < len(self.blocks) else None
+            if old_block:
+                for name, v in old_block.vars.items():
+                    nv = copy.copy(v)
+                    nv.block = b
+                    nv.desc = b.desc.vars.get(name, v.desc)
+                    b.vars[name] = nv
+        new.current_block_idx = 0
+        new.random_seed = self.random_seed
+        new._parameters = {
+            k: new.global_block().vars.get(k, v)
+            for k, v in self._parameters.items()
+        }
+        new._bump_version()
+        if for_test:
+            new._is_test = True
+            _flip_is_test(new.desc)
+        return new
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = []
+        for b in self.desc.blocks:
+            lines.append("-- block %d --" % b.idx)
+            for name, v in sorted(b.vars.items()):
+                lines.append("  var %s" % v)
+            for op in b.ops:
+                lines.append("  %s" % op)
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+
+def _flip_is_test(program_desc):
+    for b in program_desc.blocks:
+        for op in b.ops:
+            if "is_test" in op.attrs or op.type in ("dropout", "batch_norm", "lrn"):
+                op.attrs["is_test"] = True
+
+
+# -- default program singletons (reference: framework.py:2597-2665) --------
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program():
+    return _main_program_
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    old = _main_program_
+    _main_program_ = program
+    return old
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    old = _startup_program_
+    _startup_program_ = program
+    return old
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self.old_main = switch_main_program(self.main)
+        if self.startup is not None:
+            self.old_startup = switch_startup_program(self.startup)
+        return self
+
+    def __exit__(self, *args):
+        switch_main_program(self.old_main)
+        if self.startup is not None:
+            switch_startup_program(self.old_startup)
+        return False
+
+
+def grad_var_name(name):
+    return name + "@GRAD"

@@ -1,0 +1,179 @@
+"""Save/load + inference export (reference: python/paddle/fluid/io.py —
+save_params:213, save_persistables:441, load_persistables:657,
+save_inference_model:862, load_inference_model:1014). The reference runs
+synthesized programs of ``save``/``load`` ops through the executor; here the
+scope holds device arrays, so checkpointing is a host-side serialization of
+the persistable vars (npz shards) + the program JSON — the
+tensorstore-style async variant can layer on orbax later."""
+
+import json
+import os
+
+import numpy as np
+
+from paddle_tpu.core.desc import ProgramDescData
+from paddle_tpu.framework import Program, default_main_program, Block
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+]
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def _is_parameter(var):
+    from paddle_tpu.framework import Parameter
+
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    from paddle_tpu.executor import global_scope
+
+    scope = global_scope()
+    arrays = {}
+    for v in vars:
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        arrays[v.name] = np.asarray(val)
+    if filename is None:
+        filename = "__combined__.npz"
+    np.savez(os.path.join(dirname, filename), **arrays)
+    return list(arrays)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    if filename is None:
+        filename = "__combined__.npz"
+    data = np.load(os.path.join(dirname, filename))
+    from paddle_tpu.executor import global_scope
+
+    scope = global_scope()
+    loaded = []
+    for v in vars:
+        if v.name in data:
+            scope.set(v.name, data[v.name])
+            loaded.append(v.name)
+    return loaded
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def _prune_for_inference(program, feed_names, fetch_names):
+    """Backward-slice the program to the ops needed for the fetches
+    (reference: framework prune.cc via io.py:862)."""
+    pruned = Program()
+    src = program.desc.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for i in range(len(src.ops) - 1, -1, -1):
+        op = src.ops[i]
+        if op.type.endswith("_grad") or op.type in (
+            "sgd", "momentum", "adam", "adamax", "adagrad", "rmsprop",
+            "adadelta", "ftrl", "lars_momentum", "decayed_adagrad",
+        ):
+            continue
+        if any(n in needed for n in op.output_arg_names()):
+            keep.append(i)
+            needed.update(op.input_arg_names())
+    keep.reverse()
+
+    dst = pruned.desc.global_block()
+    import copy
+
+    for name, vd in src.vars.items():
+        dst.vars[name] = copy.deepcopy(vd)
+    for i in keep:
+        dst.ops.append(copy.deepcopy(src.ops[i]))
+    pruned._bump_version()
+    pruned.blocks = [Block(pruned, 0)]
+    # re-wrap vars
+    for name in dst.vars:
+        b = pruned.blocks[0]
+        from paddle_tpu.framework import Variable
+
+        v = Variable.__new__(Variable)
+        v.block = b
+        v.desc = dst.vars[name]
+        b.vars[name] = v
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    main_program = main_program or default_main_program()
+    fetch_names = [v.name for v in target_vars]
+    pruned = _prune_for_inference(main_program, feeded_var_names, fetch_names)
+    # mark test mode on serialized program
+    from paddle_tpu.framework import _flip_is_test
+
+    _flip_is_test(pruned.desc)
+    os.makedirs(dirname, exist_ok=True)
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        f.write(pruned.desc.serialize_to_string())
+    meta = {"feed_names": feeded_var_names, "fetch_names": fetch_names}
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, main_program,
+                      filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        desc = ProgramDescData.parse_from_string(f.read())
+    program = Program()
+    program.desc = desc
+    desc._version_token = 1
+    program.blocks = [Block(program, i) for i in range(desc.num_blocks())]
+    for b in program.blocks:
+        from paddle_tpu.framework import Variable
+
+        for name, vd in b.desc.vars.items():
+            v = Variable.__new__(Variable)
+            v.block = b
+            v.desc = vd
+            b.vars[name] = v
+    program._is_test = True
+    with open(os.path.join(dirname, "__meta__.json")) as f:
+        meta = json.load(f)
+    load_persistables(executor, dirname, program, filename=params_filename)
+    feed_names = meta["feed_names"]
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, feed_names, fetch_vars

@@ -1,0 +1,25 @@
+"""Data-entry layers (reference: python/paddle/fluid/layers/io.py — data:39)."""
+
+from paddle_tpu.framework import default_main_program
+from paddle_tpu.core.types import VarType
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True, type=VarType.LOD_TENSOR):
+    """Declare a feed variable (reference: layers/io.py:39). With
+    ``append_batch_size`` a -1 batch dim is prepended, exactly like the
+    reference."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().current_block()
+    if name in block.vars:
+        return block.vars[name]
+    return block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        stop_gradient=stop_gradient,
+        type=type,
+    )

@@ -1,0 +1,76 @@
+"""Metric layers (reference: python/paddle/fluid/layers/metric_op.py)."""
+
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.initializer import ConstantInitializer
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    topk_indices = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="top_k",
+        inputs={"X": [input]},
+        outputs={"Out": [topk_out], "Indices": [topk_indices]},
+        attrs={"k": k},
+    )
+    acc_out = helper.create_variable_for_type_inference(dtype="float32")
+    if correct is None:
+        correct = helper.create_variable_for_type_inference(dtype="int32")
+    if total is None:
+        total = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="accuracy",
+        inputs={
+            "Out": [topk_out],
+            "Indices": [topk_indices],
+            "Label": [label],
+        },
+        outputs={
+            "Accuracy": [acc_out],
+            "Correct": [correct],
+            "Total": [total],
+        },
+    )
+    acc_out.stop_gradient = True
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming AUC with persistable stat accumulators
+    (reference: layers/metric_op.py auc)."""
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        persistable=True,
+        name=helper.name + ".stat_pos",
+        shape=[num_thresholds + 1],
+        dtype="int64",
+    )
+    helper.set_variable_initializer(stat_pos, ConstantInitializer(0))
+    stat_neg = helper.create_global_variable(
+        persistable=True,
+        name=helper.name + ".stat_neg",
+        shape=[num_thresholds + 1],
+        dtype="int64",
+    )
+    helper.set_variable_initializer(stat_neg, ConstantInitializer(0))
+
+    auc_out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="auc",
+        inputs={
+            "Predict": [input],
+            "Label": [label],
+            "StatPos": [stat_pos],
+            "StatNeg": [stat_neg],
+        },
+        outputs={
+            "AUC": [auc_out],
+            "StatPosOut": [stat_pos],
+            "StatNegOut": [stat_neg],
+        },
+        attrs={"curve": curve, "num_thresholds": num_thresholds},
+    )
+    auc_out.stop_gradient = True
+    return auc_out, [stat_pos, stat_neg]

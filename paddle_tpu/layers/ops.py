@@ -1,0 +1,53 @@
+"""Auto-generated unary activation layers (reference:
+python/paddle/fluid/layers/ops.py via layer_function_generator.py)."""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "softshrink",
+    "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin", "round",
+    "reciprocal", "square", "softplus", "softsign", "hard_sigmoid",
+    "swish", "relu6", "elu", "gelu", "brelu", "soft_relu", "hard_shrink",
+    "thresholded_relu", "stanh", "sign", "log",
+]
+
+__all__ = list(_UNARY_OPS) + ["uniform_random"]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None, **kwargs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(
+            type=op_type,
+            inputs={"X": [x]},
+            outputs={"Out": [out]},
+            attrs=kwargs,
+        )
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+for _op in _UNARY_OPS:
+    globals()[_op] = _make_unary(_op)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="uniform_random",
+        outputs={"Out": [out]},
+        attrs={
+            "shape": list(shape),
+            "dtype": int(convert_np_dtype_to_dtype_(dtype)),
+            "min": float(min),
+            "max": float(max),
+            "seed": seed,
+        },
+    )
+    return out

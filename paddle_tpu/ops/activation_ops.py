@@ -1,0 +1,139 @@
+"""Activations (reference: paddle/fluid/operators/activation_op.cc) —
+pure elementwise lowerings that XLA fuses into adjacent matmuls/convs."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import single
+
+
+def _unary(fn):
+    def lower(ctx, ins, attrs):
+        return {"Out": [fn(single(ins, "X"))]}
+
+    return lower
+
+
+register_op("relu")(_unary(jax.nn.relu))
+register_op("sigmoid")(_unary(jax.nn.sigmoid))
+register_op("logsigmoid")(_unary(jax.nn.log_sigmoid))
+register_op("tanh")(_unary(jnp.tanh))
+register_op("exp")(_unary(jnp.exp))
+register_op("log")(_unary(jnp.log))
+register_op("sqrt")(_unary(jnp.sqrt))
+register_op("rsqrt")(_unary(lambda x: 1.0 / jnp.sqrt(x)))
+register_op("square")(_unary(jnp.square))
+register_op("abs")(_unary(jnp.abs))
+register_op("reciprocal")(_unary(lambda x: 1.0 / x))
+register_op("softsign")(_unary(lambda x: x / (1.0 + jnp.abs(x))))
+register_op("softplus")(_unary(jax.nn.softplus))
+register_op("tanh_shrink")(_unary(lambda x: x - jnp.tanh(x)))
+register_op("sin")(_unary(jnp.sin))
+register_op("cos")(_unary(jnp.cos))
+register_op("floor", grad=None)(_unary(jnp.floor))
+register_op("ceil", grad=None)(_unary(jnp.ceil))
+register_op("round", grad=None)(_unary(jnp.round))
+register_op("sign", grad=None)(_unary(jnp.sign))
+
+
+@register_op("gelu")
+def gelu(ctx, ins, attrs):
+    approximate = attrs.get("approximate", False)
+    return {"Out": [jax.nn.gelu(single(ins, "X"), approximate=approximate)]}
+
+
+@register_op("leaky_relu")
+def leaky_relu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    x = single(ins, "X")
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register_op("relu6")
+def relu6(ctx, ins, attrs):
+    threshold = attrs.get("threshold", 6.0)
+    return {"Out": [jnp.clip(single(ins, "X"), 0.0, threshold)]}
+
+
+@register_op("elu")
+def elu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 1.0)
+    x = single(ins, "X")
+    return {"Out": [jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))]}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    x = single(ins, "X")
+    return {"Out": [jnp.clip(slope * x + offset, 0.0, 1.0)]}
+
+
+@register_op("swish")
+def swish(ctx, ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    x = single(ins, "X")
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register_op("brelu")
+def brelu(ctx, ins, attrs):
+    t_min = attrs.get("t_min", 0.0)
+    t_max = attrs.get("t_max", 24.0)
+    return {"Out": [jnp.clip(single(ins, "X"), t_min, t_max)]}
+
+
+@register_op("soft_relu")
+def soft_relu(ctx, ins, attrs):
+    threshold = attrs.get("threshold", 40.0)
+    x = jnp.clip(single(ins, "X"), -threshold, threshold)
+    return {"Out": [jnp.log(1.0 + jnp.exp(x))]}
+
+
+@register_op("pow_activation")
+def pow_activation(ctx, ins, attrs):
+    return {"Out": [jnp.power(single(ins, "X"), attrs.get("factor", 1.0))]}
+
+
+@register_op("stanh")
+def stanh(ctx, ins, attrs):
+    a = attrs.get("scale_a", 2.0 / 3.0)
+    b = attrs.get("scale_b", 1.7159)
+    return {"Out": [b * jnp.tanh(a * single(ins, "X"))]}
+
+
+@register_op("hard_shrink")
+def hard_shrink(ctx, ins, attrs):
+    threshold = attrs.get("threshold", 0.5)
+    x = single(ins, "X")
+    return {"Out": [jnp.where(jnp.abs(x) > threshold, x, 0.0)]}
+
+
+@register_op("softshrink")
+def softshrink(ctx, ins, attrs):
+    lam = attrs.get("lambda", 0.5)
+    x = single(ins, "X")
+    return {"Out": [jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0))]}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(ctx, ins, attrs):
+    threshold = attrs.get("threshold", 1.0)
+    x = single(ins, "X")
+    return {"Out": [jnp.where(x > threshold, x, 0.0)]}
+
+
+@register_op("softmax")
+def softmax(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.softmax(x, axis=axis)]}
+
+
+@register_op("log_softmax")
+def log_softmax(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.log_softmax(x, axis=axis)]}

@@ -1,0 +1,42 @@
+"""Shared helpers for op lowerings."""
+
+import jax.numpy as jnp
+
+
+def bcast_y_to_x(x, y, axis):
+    """Fluid elementwise broadcast: align Y's dims to X starting at ``axis``
+    (reference: paddle/fluid/operators/elementwise/elementwise_op_function.h,
+    the trim-trailing-ones + mid-broadcast rule)."""
+    if x.shape == y.shape:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    # Trim trailing 1s of y (reference does this before computing n/post)
+    y_shape = list(y.shape)
+    while y_shape and y_shape[-1] == 1 and len(y_shape) > 1:
+        if axis + len(y_shape) > x.ndim or x.shape[axis + len(y_shape) - 1] != 1:
+            y_shape = y_shape[:-1]
+        else:
+            break
+    y = y.reshape(y_shape) if tuple(y_shape) != y.shape else y
+    new_shape = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        new_shape[axis + i] = d
+    return y.reshape(new_shape)
+
+
+def flatten_to_2d(x, num_col_dims):
+    """Reference ``mul`` op semantics: flatten leading ``num_col_dims`` dims
+    into rows, rest into cols (paddle/fluid/operators/mul_op.cc)."""
+    rows = 1
+    for d in x.shape[:num_col_dims]:
+        rows *= d
+    cols = 1
+    for d in x.shape[num_col_dims:]:
+        cols *= d
+    return x.reshape(rows, cols)
+
+
+def single(ins, slot, default=None):
+    vals = ins.get(slot, [])
+    return vals[0] if vals else default

@@ -1,0 +1,49 @@
+"""Compare/logical ops + control-flow glue
+(reference: paddle/fluid/operators/controlflow/)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_no_grad_op
+from paddle_tpu.ops.common import single
+
+
+def _cmp(fn):
+    def lower(ctx, ins, attrs):
+        x = single(ins, "X")
+        y = single(ins, "Y")
+        return {"Out": [fn(x, y)]}
+
+    return lower
+
+
+register_no_grad_op("equal")(_cmp(jnp.equal))
+register_no_grad_op("not_equal")(_cmp(jnp.not_equal))
+register_no_grad_op("less_than")(_cmp(jnp.less))
+register_no_grad_op("less_equal")(_cmp(jnp.less_equal))
+register_no_grad_op("greater_than")(_cmp(jnp.greater))
+register_no_grad_op("greater_equal")(_cmp(jnp.greater_equal))
+
+
+def _logical(fn):
+    def lower(ctx, ins, attrs):
+        x = single(ins, "X")
+        y = single(ins, "Y")
+        if y is None:
+            return {"Out": [fn(x)]}
+        return {"Out": [fn(x, y)]}
+
+    return lower
+
+
+register_no_grad_op("logical_and")(_logical(jnp.logical_and))
+register_no_grad_op("logical_or")(_logical(jnp.logical_or))
+register_no_grad_op("logical_xor")(_logical(jnp.logical_xor))
+register_no_grad_op("logical_not")(_logical(jnp.logical_not))
+
+
+@register_no_grad_op("where")
+def where_op(ctx, ins, attrs):
+    cond = single(ins, "Condition")
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    return {"Out": [jnp.where(cond, x, y)]}

@@ -1,0 +1,146 @@
+"""Loss ops (reference: cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, mean_op.cc, squared_l2 ops...)."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import single
+
+
+def _squeeze_label(label):
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        return jnp.squeeze(label, axis=-1)
+    return label
+
+
+@register_op("cross_entropy", no_grad_inputs=("Label",))
+def cross_entropy(ctx, ins, attrs):
+    x = single(ins, "X")  # probabilities
+    label = single(ins, "Label")
+    soft = attrs.get("soft_label", False)
+    eps = 1e-8
+    if soft:
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1, keepdims=True)
+    else:
+        idx = _squeeze_label(label)
+        picked = jnp.take_along_axis(x, idx[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, eps))
+    return {"Y": [loss]}
+
+
+@register_op("softmax_with_cross_entropy", no_grad_inputs=("Label",))
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = single(ins, "Logits")
+    label = single(ins, "Label")
+    soft = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    log_sm = jax.nn.log_softmax(logits, axis=-1)
+    softmax_out = jnp.exp(log_sm)
+    if soft:
+        loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
+    else:
+        idx = _squeeze_label(label)
+        picked = jnp.take_along_axis(log_sm, idx[..., None].astype(jnp.int32), axis=-1)
+        loss = -picked
+        if ignore_index >= 0:
+            loss = jnp.where(idx[..., None] == ignore_index, 0.0, loss)
+    return {"Softmax": [softmax_out], "Loss": [loss]}
+
+
+@register_op("mean")
+def mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(single(ins, "X"))]}
+
+
+@register_op("square_error_cost")
+def square_error_cost(ctx, ins, attrs):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx, ins, attrs):
+    x = single(ins, "X")
+    return {"Out": [jnp.sum(jnp.square(x)).reshape(1)]}
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ctx, ins, attrs):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    diff = x - y
+    return {
+        "sub_result": [diff],
+        "Out": [jnp.sum(jnp.square(diff), axis=-1, keepdims=True)],
+    }
+
+
+@register_op("sigmoid_cross_entropy_with_logits", no_grad_inputs=("Label",))
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x = single(ins, "X")
+    label = single(ins, "Label")
+    ignore_index = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = jnp.where(label == ignore_index, 0.0, loss)
+    if attrs.get("normalize", False):
+        n_valid = jnp.maximum(jnp.sum(label != ignore_index).astype(x.dtype), 1.0)
+        loss = loss / n_valid
+    return {"Out": [loss]}
+
+
+@register_op("log_loss", no_grad_inputs=("Labels",))
+def log_loss(ctx, ins, attrs):
+    pred = single(ins, "Predicted")
+    label = single(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(pred + eps) - (1.0 - label) * jnp.log(1.0 - pred + eps)
+    return {"Loss": [loss]}
+
+
+@register_op("huber_loss", no_grad_inputs=("Y",))
+def huber_loss(ctx, ins, attrs):
+    x = single(ins, "X")  # prediction
+    y = single(ins, "Y")  # label
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Residual": [r], "Out": [loss]}
+
+
+@register_op("smooth_l1_loss", no_grad_inputs=("Y",))
+def smooth_l1_loss(ctx, ins, attrs):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    out = jnp.sum(elem.reshape(elem.shape[0], -1), axis=1, keepdims=True)
+    return {"Diff": [diff], "Out": [out]}
+
+
+@register_op("kldiv_loss", no_grad_inputs=("Target",))
+def kldiv_loss(ctx, ins, attrs):
+    x = single(ins, "X")  # log-probabilities
+    target = single(ins, "Target")
+    reduction = attrs.get("reduction", "mean")
+    loss = target * (jnp.log(jnp.maximum(target, 1e-8)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    if reduction == "mean":
+        return {"Loss": [jnp.mean(loss)]}
+    if reduction == "sum":
+        return {"Loss": [jnp.sum(loss)]}
+    if reduction == "batchmean":
+        return {"Loss": [jnp.sum(loss) / x.shape[0]]}
+    return {"Loss": [loss]}
+
+
+@register_op("hinge_loss", no_grad_inputs=("Labels",))
+def hinge_loss(ctx, ins, attrs):
+    logits = single(ins, "Logits")
+    labels = single(ins, "Labels")
+    return {"Loss": [jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)]}

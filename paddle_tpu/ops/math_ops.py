@@ -1,0 +1,106 @@
+"""Math ops: mul/matmul, elementwise binary ops, scale, sum...
+
+Capability match for reference mul_op.cc, matmul_op.cc,
+operators/elementwise/*, scale_op.cc, sum_op.cc — each lowered to jnp/lax so
+XLA maps the matmuls onto the MXU and fuses the elementwise ops into
+neighbors.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import bcast_y_to_x, flatten_to_2d, single
+
+
+@register_op("mul")
+def mul(ctx, ins, attrs):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    x2 = flatten_to_2d(x, xnc)
+    y2 = flatten_to_2d(y, ync)
+    out = x2 @ y2
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register_op("matmul")
+def matmul(ctx, ins, attrs):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ty:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+def _elementwise(fn):
+    def lower(ctx, ins, attrs):
+        x = single(ins, "X")
+        y = single(ins, "Y")
+        y = bcast_y_to_x(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return lower
+
+
+register_op("elementwise_add")(_elementwise(jnp.add))
+register_op("elementwise_sub")(_elementwise(jnp.subtract))
+register_op("elementwise_mul")(_elementwise(jnp.multiply))
+register_op("elementwise_div")(_elementwise(jnp.divide))
+register_op("elementwise_max")(_elementwise(jnp.maximum))
+register_op("elementwise_min")(_elementwise(jnp.minimum))
+register_op("elementwise_pow")(_elementwise(jnp.power))
+register_op("elementwise_mod", grad=None)(_elementwise(jnp.mod))
+register_op("elementwise_floordiv", grad=None)(_elementwise(jnp.floor_divide))
+
+
+@register_op("scale")
+def scale(ctx, ins, attrs):
+    x = single(ins, "X")
+    s = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    bias_after = attrs.get("bias_after_scale", True)
+    if bias_after:
+        out = x * s + bias
+    else:
+        out = (x + bias) * s
+    return {"Out": [out]}
+
+
+@register_op("sum")
+def sum_op(ctx, ins, attrs):
+    xs = ins.get("X", [])
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("pow")
+def pow_op(ctx, ins, attrs):
+    x = single(ins, "X")
+    return {"Out": [jnp.power(x, attrs.get("factor", 1.0))]}
+
+
+@register_op("clip")
+def clip(ctx, ins, attrs):
+    x = single(ins, "X")
+    return {"Out": [jnp.clip(x, attrs.get("min"), attrs.get("max"))]}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx, ins, attrs):
+    x = single(ins, "X")
+    max_norm = attrs.get("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    out = jnp.where(norm > max_norm, x * (max_norm / jnp.maximum(norm, 1e-12)), x)
+    return {"Out": [out]}

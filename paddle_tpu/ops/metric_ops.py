@@ -1,0 +1,66 @@
+"""Metric ops (reference: paddle/fluid/operators/metrics/accuracy_op.cc,
+auc_op.cc)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_no_grad_op
+from paddle_tpu.ops.common import single
+
+
+@register_no_grad_op("accuracy")
+def accuracy(ctx, ins, attrs):
+    indices = single(ins, "Indices")  # [N, k] top-k class indices
+    label = single(ins, "Label")  # [N, 1]
+    n = indices.shape[0]
+    correct = jnp.any(indices == label.reshape(-1, 1), axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    acc = num_correct.astype(jnp.float32) / n
+    return {
+        "Accuracy": [acc.reshape(1)],
+        "Correct": [num_correct.reshape(1)],
+        "Total": [jnp.full((1,), n, dtype=jnp.int32)],
+    }
+
+
+@register_no_grad_op("auc")
+def auc(ctx, ins, attrs):
+    """Streaming AUC: updates histogram stat accumulators like the
+    reference's auc_op (operators/metrics/auc_op.cc)."""
+    predict = single(ins, "Predict")  # [N, 2] binary probs
+    label = single(ins, "Label")  # [N, 1]
+    stat_pos = single(ins, "StatPos")
+    stat_neg = single(ins, "StatNeg")
+    num_thresholds = attrs.get("num_thresholds", 4095)
+
+    pos_prob = predict[:, 1]
+    bucket = jnp.clip(
+        (pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds
+    )
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos_hist = jnp.zeros_like(stat_pos).at[bucket].add(lab.astype(stat_pos.dtype))
+    neg_hist = jnp.zeros_like(stat_neg).at[bucket].add(
+        (1 - lab).astype(stat_neg.dtype)
+    )
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+
+    # AUC by trapezoid over descending-threshold cumulative TP/FP
+    tp = jnp.cumsum(new_pos[::-1])
+    fp = jnp.cumsum(new_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc_val = jnp.where(
+        (tot_pos > 0) & (tot_neg > 0),
+        area / jnp.maximum(tot_pos * tot_neg, 1.0),
+        jnp.asarray(0.0, dtype=jnp.float64)
+        if area.dtype == jnp.float64
+        else 0.0,
+    )
+    return {
+        "AUC": [jnp.asarray(auc_val, dtype=jnp.float32).reshape(())],
+        "StatPosOut": [new_pos],
+        "StatNegOut": [new_neg],
+    }

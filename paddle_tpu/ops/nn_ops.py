@@ -1,0 +1,345 @@
+"""NN ops: conv, pool, batch_norm, layer_norm, dropout, embedding...
+
+Reference: paddle/fluid/operators/conv_op.cc (+conv_cudnn_op.cu.cc),
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc,
+lookup_table_op.cc. Lowerings emit lax convolutions (MXU) and keep the
+public NCHW layout contract; XLA's TPU layout assignment picks the physical
+layout, so no data_layout_transform pass is needed (reference:
+paddle/fluid/framework/data_layout_transform.cc becomes a no-op concern).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import single
+
+
+def _conv_dn(ndim):
+    if ndim == 4:
+        return lax.conv_dimension_numbers(
+            (1, 1, 1, 1), (1, 1, 1, 1), ("NCHW", "OIHW", "NCHW")
+        )
+    raise NotImplementedError
+
+
+@register_op("conv2d")
+def conv2d(ctx, ins, attrs):
+    x = single(ins, "Input")  # NCHW
+    w = single(ins, "Filter")  # OIHW (I = C/groups)
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ctx, ins, attrs):
+    x = single(ins, "Input")
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return conv2d(ctx, ins, attrs)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx, ins, attrs):
+    x = single(ins, "Input")  # NCHW
+    w = single(ins, "Filter")  # IOHW in paddle transpose convs
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
+    out = lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    return {"Output": [out]}
+
+
+@register_op("pool2d")
+def pool2d(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = attrs.get("ksize", [2, 2])
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+    global_pooling = attrs.get("global_pooling", False)
+    exclusive = attrs.get("exclusive", True)
+    adaptive = attrs.get("adaptive", False)
+    ceil_mode = attrs.get("ceil_mode", False)
+
+    if global_pooling or (adaptive and list(ksize) == [1, 1]):
+        if ptype == "max":
+            out = jnp.max(x, axis=(2, 3), keepdims=True)
+        else:
+            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+        return {"Out": [out]}
+
+    window = (1, 1, ksize[0], ksize[1])
+    strides_ = (1, 1, strides[0], strides[1])
+    if ceil_mode:
+        # pad right/bottom enough that the last partial window is included
+        def _extra(in_sz, k, s, p):
+            out_sz = -(-(in_sz + 2 * p - k) // s) + 1
+            needed = (out_sz - 1) * s + k - in_sz - p
+            return max(needed, p)
+
+        eh = _extra(x.shape[2], ksize[0], strides[0], paddings[0])
+        ew = _extra(x.shape[3], ksize[1], strides[1], paddings[1])
+        pads = ((0, 0), (0, 0), (paddings[0], eh), (paddings[1], ew))
+    else:
+        pads = (
+            (0, 0),
+            (0, 0),
+            (paddings[0], paddings[0]),
+            (paddings[1], paddings[1]),
+        )
+
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides_, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides_, pads)
+        if exclusive:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides_, pads)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register_op(
+    "batch_norm",
+    no_grad_inputs=("Mean", "Variance"),
+)
+def batch_norm(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW or ND(C last? paddle: NCHW default)
+    scale = single(ins, "Scale")
+    bias = single(ins, "Bias")
+    mean_in = single(ins, "Mean")
+    var_in = single(ins, "Variance")
+    momentum = attrs.get("momentum", 0.9)
+    eps = attrs.get("epsilon", 1e-5)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    use_global = attrs.get("use_global_stats", False) or is_test
+
+    if layout == "NCHW" and x.ndim == 4:
+        axes = (0, 2, 3)
+        param_shape = (1, -1, 1, 1)
+    elif x.ndim == 2:
+        axes = (0,)
+        param_shape = (1, -1)
+    else:  # NHWC
+        axes = tuple(range(x.ndim - 1))
+        param_shape = (1,) * (x.ndim - 1) + (-1,)
+
+    if use_global:
+        mean = mean_in
+        var = var_in
+        mean_out, var_out = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+    else:
+        mean = jnp.mean(x, axis=axes)
+        # biased variance (reference uses biased for normalization)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        mean_s = lax.stop_gradient(mean)
+        var_s = lax.stop_gradient(var)
+        mean_out = momentum * mean_in + (1.0 - momentum) * mean_s
+        var_out = momentum * var_in + (1.0 - momentum) * var_s
+        saved_mean = mean_s
+        saved_var = var_s
+
+    inv_std = lax.rsqrt(var + eps)
+    y = (x - mean.reshape(param_shape)) * inv_std.reshape(param_shape)
+    y = y * scale.reshape(param_shape) + bias.reshape(param_shape)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register_op("layer_norm")
+def layer_norm(ctx, ins, attrs):
+    x = single(ins, "X")
+    scale = single(ins, "Scale")
+    bias = single(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return {
+        "Y": [y],
+        "Mean": [jnp.squeeze(mean)],
+        "Variance": [jnp.squeeze(var)],
+    }
+
+
+@register_op("dropout", needs_rng=True)
+def dropout(ctx, ins, attrs):
+    x = single(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": [x], "Mask": [jnp.ones_like(x)]}
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register_op("lookup_table", no_grad_inputs=("Ids",))
+def lookup_table(ctx, ins, attrs):
+    w = single(ins, "W")
+    ids = single(ins, "Ids")
+    padding_idx = attrs.get("padding_idx", -1)
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    flat_ids = jnp.squeeze(ids, axis=-1) if squeeze_last else ids
+    out = jnp.take(w, flat_ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        pad_mask = (flat_ids == padding_idx)[..., None]
+        out = jnp.where(pad_mask, 0.0, out)
+    return {"Out": [out]}
+
+
+@register_op("lrn")
+def lrn(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    # sum over channel window via padded cumulative trick
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window = sum(
+        padded[:, i : i + x.shape[1], :, :] for i in range(n)
+    )
+    return {"Out": [x / jnp.power(k + alpha * window, beta)],
+            "MidOut": [k + alpha * window]}
+
+
+@register_op("l2_normalize")
+def l2_normalize(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return {"Out": [x / jnp.maximum(norm, eps)], "Norm": [norm]}
+
+
+@register_op("norm")
+def norm(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm_v = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm_v], "Norm": [norm_v]}
+
+
+@register_op("group_norm")
+def group_norm(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    scale = single(ins, "Scale")
+    bias = single(ins, "Bias")
+    groups = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    g = x.reshape(n, groups, c // groups, *x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(g - mean), axis=axes, keepdims=True)
+    y = ((g - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    pshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(pshape)
+    if bias is not None:
+        y = y + bias.reshape(pshape)
+    return {"Y": [y], "Mean": [jnp.squeeze(mean)], "Variance": [jnp.squeeze(var)]}
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    out_h = attrs.get("out_h")
+    out_w = attrs.get("out_w")
+    out = jax.image.resize(
+        x, (x.shape[0], x.shape[1], out_h, out_w), method="bilinear"
+    )
+    return {"Out": [out]}
+
+
+@register_op("nearest_interp")
+def nearest_interp(ctx, ins, attrs):
+    x = single(ins, "X")
+    out_h = attrs.get("out_h")
+    out_w = attrs.get("out_w")
+    out = jax.image.resize(
+        x, (x.shape[0], x.shape[1], out_h, out_w), method="nearest"
+    )
+    return {"Out": [out]}
+
+
+@register_op("prelu")
+def prelu(ctx, ins, attrs):
+    x = single(ins, "X")
+    alpha = single(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": [jnp.where(x > 0, x, a * x)]}
+
+
+@register_op("maxout")
+def maxout(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    groups = attrs.get("groups")
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // groups, groups, h, w).max(axis=2)
+    return {"Out": [out]}

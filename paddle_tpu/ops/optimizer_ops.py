@@ -1,0 +1,232 @@
+"""Optimizer update ops (reference: paddle/fluid/operators/optimizers/):
+sgd, momentum, lars_momentum, adam, adamax, adagrad, decayed_adagrad,
+adadelta, rmsprop, ftrl. Functional lowerings whose outputs alias the
+parameter/accumulator inputs via buffer donation (see engine/executor.py) —
+the XLA equivalent of the reference's in-place kernels."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_no_grad_op
+from paddle_tpu.ops.common import single
+
+
+@register_no_grad_op("sgd", inplace_map={"ParamOut": "Param"})
+def sgd(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    lr = single(ins, "LearningRate")
+    return {"ParamOut": [p - lr.reshape(()) * g]}
+
+
+@register_no_grad_op(
+    "momentum", inplace_map={"ParamOut": "Param", "VelocityOut": "Velocity"}
+)
+def momentum(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    v = single(ins, "Velocity")
+    lr = single(ins, "LearningRate").reshape(())
+    mu = attrs.get("mu")
+    use_nesterov = attrs.get("use_nesterov", False)
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_no_grad_op(
+    "lars_momentum", inplace_map={"ParamOut": "Param", "VelocityOut": "Velocity"}
+)
+def lars_momentum(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    v = single(ins, "Velocity")
+    lr = single(ins, "LearningRate").reshape(())
+    mu = attrs.get("mu")
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + decay * p_norm + 1e-12),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + decay * p)
+    p_out = p - v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_no_grad_op(
+    "adam",
+    inplace_map={
+        "ParamOut": "Param",
+        "Moment1Out": "Moment1",
+        "Moment2Out": "Moment2",
+    },
+)
+def adam(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    m1 = single(ins, "Moment1")
+    m2 = single(ins, "Moment2")
+    lr = single(ins, "LearningRate").reshape(())
+    b1p = single(ins, "Beta1Pow").reshape(())
+    b2p = single(ins, "Beta2Pow").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1o = b1 * m1 + (1.0 - b1) * g
+    m2o = b2 * m2 + (1.0 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    p_out = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [p_out], "Moment1Out": [m1o], "Moment2Out": [m2o]}
+
+
+@register_no_grad_op(
+    "adamax",
+    inplace_map={
+        "ParamOut": "Param",
+        "MomentOut": "Moment",
+        "InfNormOut": "InfNorm",
+    },
+)
+def adamax(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    m = single(ins, "Moment")
+    inf = single(ins, "InfNorm")
+    lr = single(ins, "LearningRate").reshape(())
+    b1p = single(ins, "Beta1Pow").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1.0 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    lr_t = lr / (1.0 - b1p)
+    p_out = p - lr_t * m_out / inf_out
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out]}
+
+
+@register_no_grad_op(
+    "adagrad", inplace_map={"ParamOut": "Param", "MomentOut": "Moment"}
+)
+def adagrad(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    m = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_no_grad_op(
+    "decayed_adagrad", inplace_map={"ParamOut": "Param", "MomentOut": "Moment"}
+)
+def decayed_adagrad(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    m = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1.0 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_no_grad_op(
+    "adadelta",
+    inplace_map={
+        "ParamOut": "Param",
+        "AvgSquaredGradOut": "AvgSquaredGrad",
+        "AvgSquaredUpdateOut": "AvgSquaredUpdate",
+    },
+)
+def adadelta(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    asg = single(ins, "AvgSquaredGrad")
+    asu = single(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asg_out = rho * asg + (1.0 - rho) * jnp.square(g)
+    update = -jnp.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1.0 - rho) * jnp.square(update)
+    return {
+        "ParamOut": [p + update],
+        "AvgSquaredGradOut": [asg_out],
+        "AvgSquaredUpdateOut": [asu_out],
+    }
+
+
+@register_no_grad_op(
+    "rmsprop",
+    inplace_map={
+        "ParamOut": "Param",
+        "MomentOut": "Moment",
+        "MeanSquareOut": "MeanSquare",
+        "MeanGradOut": "MeanGrad",
+    },
+)
+def rmsprop(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    mom = single(ins, "Moment")
+    ms = single(ins, "MeanSquare")
+    mg = single(ins, "MeanGrad")
+    lr = single(ins, "LearningRate").reshape(())
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum_ = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_out = rho * ms + (1.0 - rho) * jnp.square(g)
+    if centered:
+        mg_out = rho * mg + (1.0 - rho) * g
+        mom_out = momentum_ * mom + lr * g / jnp.sqrt(
+            ms_out - jnp.square(mg_out) + eps
+        )
+    else:
+        mg_out = mg
+        mom_out = momentum_ * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {
+        "ParamOut": [p - mom_out],
+        "MomentOut": [mom_out],
+        "MeanSquareOut": [ms_out],
+        "MeanGradOut": [mg_out],
+    }
+
+
+@register_no_grad_op(
+    "ftrl",
+    inplace_map={
+        "ParamOut": "Param",
+        "SquaredAccumOut": "SquaredAccumulator",
+        "LinearAccumOut": "LinearAccumulator",
+    },
+)
+def ftrl(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    sq = single(ins, "SquaredAccumulator")
+    lin = single(ins, "LinearAccumulator")
+    lr = single(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    pre_shrink = (jnp.sign(lin_out) * l1 - lin_out) / (
+        jnp.power(new_sq, -lr_power) / lr + 2.0 * l2
+    )
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre_shrink, jnp.zeros_like(p))
+    return {
+        "ParamOut": [p_out],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [lin_out],
+    }

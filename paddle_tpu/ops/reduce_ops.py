@@ -1,0 +1,31 @@
+"""Reduce ops (reference: paddle/fluid/operators/reduce_ops/)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import single
+
+
+def _reduce(fn):
+    def lower(ctx, ins, attrs):
+        x = single(ins, "X")
+        dims = attrs.get("dim", [0])
+        keep_dim = attrs.get("keep_dim", False)
+        reduce_all = attrs.get("reduce_all", False)
+        if reduce_all:
+            axes = None
+        else:
+            axes = tuple(d if d >= 0 else d + x.ndim for d in dims)
+        out = fn(x, axis=axes, keepdims=keep_dim)
+        return {"Out": [out]}
+
+    return lower
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_all", grad=None)(_reduce(jnp.all))
+register_op("reduce_any", grad=None)(_reduce(jnp.any))

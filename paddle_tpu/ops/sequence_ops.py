@@ -1,0 +1,116 @@
+"""Sequence ops — the LoDTensor story, TPU-style.
+
+The reference's LoDTensor (paddle/fluid/framework/lod_tensor.h:44-110) stores
+ragged batches without padding and threads nested offsets through
+operators/sequence_ops/. On TPU, static shapes win: the equivalent capability
+is *padded batches + explicit length masks* (SURVEY.md §2.12 "LoD =
+bucketing/padding + masking"). These ops therefore take a padded [B, T, ...]
+tensor plus a Length tensor and mask accordingly.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import single
+
+
+def _mask(lengths, max_len, dtype=jnp.float32):
+    return (jnp.arange(max_len)[None, :] < lengths.reshape(-1, 1)).astype(dtype)
+
+
+@register_op("sequence_pool", no_grad_inputs=("Length",))
+def sequence_pool(ctx, ins, attrs):
+    x = single(ins, "X")  # [B, T, D] padded
+    lengths = single(ins, "Length")  # [B]
+    pooltype = attrs.get("pooltype", "SUM").upper()
+    mask = _mask(lengths, x.shape[1], x.dtype)[..., None]
+    if pooltype == "SUM":
+        out = jnp.sum(x * mask, axis=1)
+    elif pooltype == "AVERAGE":
+        denom = jnp.maximum(lengths.reshape(-1, 1).astype(x.dtype), 1.0)
+        out = jnp.sum(x * mask, axis=1) / denom
+    elif pooltype == "SQRT":
+        denom = jnp.sqrt(jnp.maximum(lengths.reshape(-1, 1).astype(x.dtype), 1.0))
+        out = jnp.sum(x * mask, axis=1) / denom
+    elif pooltype == "MAX":
+        neg = jnp.full_like(x, -1e38)
+        out = jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    elif pooltype == "LAST":
+        idx = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(x, idx.reshape(-1, 1, 1), axis=1)[:, 0]
+    elif pooltype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(pooltype)
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax", no_grad_inputs=("Length",))
+def sequence_softmax(ctx, ins, attrs):
+    x = single(ins, "X")  # [B, T]
+    lengths = single(ins, "Length")
+    mask = _mask(lengths, x.shape[1], x.dtype)
+    neg = jnp.where(mask > 0, x, -1e38)
+    e = jnp.exp(neg - jnp.max(neg, axis=1, keepdims=True))
+    e = e * mask
+    return {"Out": [e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-12)]}
+
+
+@register_op("sequence_expand", no_grad_inputs=("Y",))
+def sequence_expand(ctx, ins, attrs):
+    # Padded equivalent: broadcast x [B, D] across time into [B, T, D]
+    x = single(ins, "X")
+    y = single(ins, "Y")  # [B, T, ...] provides T
+    t = y.shape[1]
+    return {"Out": [jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1]))]}
+
+
+@register_op("sequence_mask", grad=None)
+def sequence_mask(ctx, ins, attrs):
+    x = single(ins, "X")  # lengths
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen < 0:
+        raise ValueError("sequence_mask on TPU needs static maxlen")
+    return {"Y": [_mask(x, maxlen)]}
+
+
+@register_op("sequence_reverse", no_grad_inputs=("Length",))
+def sequence_reverse(ctx, ins, attrs):
+    x = single(ins, "X")  # [B, T, D]
+    lengths = single(ins, "Length")
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    rev_idx = jnp.where(
+        idx < lengths.reshape(-1, 1), lengths.reshape(-1, 1) - 1 - idx, idx
+    )
+    out = jnp.take_along_axis(x, rev_idx[..., None], axis=1)
+    return {"Y": [out]}
+
+
+@register_op("im2sequence")
+def im2sequence(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    kernels = attrs.get("kernels")
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(
+        x, ((0, 0), (0, 0), (paddings[0], paddings[2]), (paddings[1], paddings[3]))
+    )
+    kh, kw = kernels
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                xp[
+                    :,
+                    :,
+                    i : i + oh * strides[0] : strides[0],
+                    j : j + ow * strides[1] : strides[1],
+                ]
+            )
+    out = jnp.stack(patches, axis=-1).reshape(n, c, oh * ow, kh * kw)
+    out = out.transpose(0, 2, 1, 3).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": [out]}

@@ -1,0 +1,363 @@
+"""Tensor creation/manipulation ops (reference: fill_constant_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc, cast_op.cc, concat_op.cc,
+reshape_op.cc, transpose_op.cc, gather_op.cc, ...)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op, register_no_grad_op
+from paddle_tpu.core.types import VarType, convert_dtype_to_np
+from paddle_tpu.ops.common import single
+
+
+def _np_dtype(attr_dtype):
+    return convert_dtype_to_np(VarType(attr_dtype))
+
+
+@register_no_grad_op("fill_constant")
+def fill_constant(ctx, ins, attrs):
+    shape = attrs.get("shape", [])
+    value = attrs.get("value", 0.0)
+    dtype = _np_dtype(attrs.get("dtype", int(VarType.FP32)))
+    return {"Out": [jnp.full(shape, value, dtype=dtype)]}
+
+
+@register_op("fill_zeros_like", grad=None)
+def fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(single(ins, "X"))]}
+
+
+@register_no_grad_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    x = single(ins, "Input")
+    shape = list(attrs.get("shape"))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    dtype = _np_dtype(attrs.get("dtype", int(VarType.FP32)))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register_no_grad_op("uniform_random", needs_rng=True)
+def uniform_random(ctx, ins, attrs):
+    shape = attrs.get("shape")
+    dtype = _np_dtype(attrs.get("dtype", int(VarType.FP32)))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    out = jax.random.uniform(
+        ctx.rng(), tuple(shape), dtype=jnp.float32, minval=lo, maxval=hi
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_no_grad_op("gaussian_random", needs_rng=True)
+def gaussian_random(ctx, ins, attrs):
+    shape = attrs.get("shape")
+    dtype = _np_dtype(attrs.get("dtype", int(VarType.FP32)))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = mean + std * jax.random.normal(ctx.rng(), tuple(shape), dtype=jnp.float32)
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_no_grad_op("truncated_gaussian_random", needs_rng=True)
+def truncated_gaussian_random(ctx, ins, attrs):
+    shape = attrs.get("shape")
+    dtype = _np_dtype(attrs.get("dtype", int(VarType.FP32)))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = mean + std * jax.random.truncated_normal(
+        ctx.rng(), -2.0, 2.0, tuple(shape), dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("cast")
+def cast(ctx, ins, attrs):
+    x = single(ins, "X")
+    dtype = _np_dtype(attrs.get("out_dtype"))
+    return {"Out": [x.astype(dtype)]}
+
+
+@register_op("concat")
+def concat(ctx, ins, attrs):
+    xs = ins.get("X", [])
+    return {"Out": [jnp.concatenate(xs, axis=attrs.get("axis", 0))]}
+
+
+@register_op("split")
+def split(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("reshape2")
+def reshape2(ctx, ins, attrs):
+    x = single(ins, "X")
+    shape = list(attrs.get("shape"))
+    # Fluid semantics: 0 means copy dim from input, -1 infers
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = x.shape[i]
+    out = x.reshape(shape)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("reshape")
+def reshape(ctx, ins, attrs):
+    x = single(ins, "X")
+    shape = list(attrs.get("shape"))
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = x.shape[i]
+    return {"Out": [x.reshape(shape)]}
+
+
+@register_op("transpose2")
+def transpose2(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis")
+    out = jnp.transpose(x, axis)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("transpose")
+def transpose(ctx, ins, attrs):
+    x = single(ins, "X")
+    return {"Out": [jnp.transpose(x, attrs.get("axis"))]}
+
+
+@register_op("squeeze2")
+def squeeze2(ctx, ins, attrs):
+    x = single(ins, "X")
+    axes = attrs.get("axes", [])
+    if axes:
+        out = x
+        for ax in sorted(axes, reverse=True):
+            out = jnp.squeeze(out, axis=ax)
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ctx, ins, attrs):
+    x = single(ins, "X")
+    out = x
+    for ax in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, axis=ax)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("stack")
+def stack(ctx, ins, attrs):
+    xs = ins.get("X", [])
+    return {"Y": [jnp.stack(xs, axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def unstack(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", 0)
+    num = x.shape[axis]
+    outs = [jnp.squeeze(a, axis=axis) for a in jnp.split(x, num, axis=axis)]
+    return {"Y": outs}
+
+
+@register_op("expand")
+def expand(ctx, ins, attrs):
+    x = single(ins, "X")
+    times = attrs.get("expand_times")
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("slice")
+def slice_op(ctx, ins, attrs):
+    x = single(ins, "Input")
+    axes = attrs.get("axes")
+    starts = attrs.get("starts")
+    ends = attrs.get("ends")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("gather")
+def gather(ctx, ins, attrs):
+    x = single(ins, "X")
+    index = single(ins, "Index")
+    return {"Out": [jnp.take(x, index, axis=0)]}
+
+
+@register_op("scatter")
+def scatter(ctx, ins, attrs):
+    x = single(ins, "X")
+    ids = single(ins, "Ids")
+    updates = single(ins, "Updates")
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(updates)
+    else:
+        out = x.at[ids].add(updates)
+    return {"Out": [out]}
+
+
+@register_op("assign")
+def assign(ctx, ins, attrs):
+    return {"Out": [single(ins, "X")]}
+
+
+@register_no_grad_op("shape")
+def shape_op(ctx, ins, attrs):
+    x = single(ins, "Input")
+    return {"Out": [jnp.asarray(x.shape, dtype=jnp.int32)]}
+
+
+@register_no_grad_op("top_k")
+def top_k(ctx, ins, attrs):
+    x = single(ins, "X")
+    k = attrs.get("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_no_grad_op("arg_max")
+def arg_max(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    return {"Out": [jnp.argmax(x, axis=axis).astype(jnp.int64)]}
+
+
+@register_no_grad_op("arg_min")
+def arg_min(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    return {"Out": [jnp.argmin(x, axis=axis).astype(jnp.int64)]}
+
+
+@register_no_grad_op("argsort")
+def argsort(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_no_grad_op("one_hot")
+def one_hot(ctx, ins, attrs):
+    x = single(ins, "X")
+    depth = attrs.get("depth")
+    ids = x
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, axis=-1)
+    return {"Out": [jax.nn.one_hot(ids, depth, dtype=jnp.float32)]}
+
+
+@register_no_grad_op("range")
+def range_op(ctx, ins, attrs):
+    start = single(ins, "Start")
+    end = single(ins, "End")
+    step = single(ins, "Step")
+    # Static only: values must be compile-time python/np scalars.
+    return {
+        "Out": [
+            jnp.arange(
+                np.asarray(start).item(),
+                np.asarray(end).item(),
+                np.asarray(step).item(),
+            )
+        ]
+    }
+
+
+@register_op("label_smooth")
+def label_smooth(ctx, ins, attrs):
+    x = single(ins, "X")
+    eps = attrs.get("epsilon", 0.0)
+    k = x.shape[-1]
+    return {"Out": [(1.0 - eps) * x + eps / k]}
+
+
+@register_op("pad")
+def pad(ctx, ins, attrs):
+    x = single(ins, "X")
+    paddings = attrs.get("paddings")
+    value = attrs.get("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, cfg, constant_values=value)]}
+
+
+@register_op("pad2d")
+def pad2d(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("pad_value", 0.0)
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, cfg, constant_values=value)]}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": [jnp.pad(x, cfg, mode=jmode)]}
+
+
+@register_no_grad_op("increment")
+def increment(ctx, ins, attrs):
+    x = single(ins, "X")
+    return {"Out": [x + attrs.get("step", 1.0)]}
+
+
+@register_no_grad_op("assign_value")
+def assign_value(ctx, ins, attrs):
+    shape = attrs.get("shape")
+    dtype = _np_dtype(attrs.get("dtype", int(VarType.FP32)))
+    if "fp32_values" in attrs and attrs["fp32_values"]:
+        vals = attrs["fp32_values"]
+    else:
+        vals = attrs.get("int32_values", [])
+    return {"Out": [jnp.asarray(np.asarray(vals, dtype=dtype).reshape(shape))]}
+
+
+@register_no_grad_op("isfinite")
+def isfinite(ctx, ins, attrs):
+    xs = ins.get("X", [])
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": [ok]}
+
+
+@register_op("cumsum")
+def cumsum(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    exclusive = attrs.get("exclusive", False)
+    reverse = attrs.get("reverse", False)
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis=axis)
+    return {"Out": [out]}
+
+
+@register_op("reverse")
+def reverse(ctx, ins, attrs):
+    x = single(ins, "X")
+    axes = attrs.get("axis")
+    if isinstance(axes, int):
+        axes = [axes]
+    out = x
+    for ax in axes:
+        out = jnp.flip(out, axis=ax)
+    return {"Out": [out]}

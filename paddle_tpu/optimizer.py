@@ -1,0 +1,518 @@
+"""Optimizers (reference: python/paddle/fluid/optimizer.py — Optimizer base
+with accumulators :0-409, SGD:410, Momentum:457, LarsMomentum:542,
+Adagrad:628, Adam:717, Adamax:877, DecayedAdagrad:1010, Adadelta:1095,
+RMSProp:1192, Ftrl:1342, ModelAverage:1484). Each appends update ops to the
+program; the XLA engine fuses them into the train step executable."""
+
+import numpy as np
+
+from paddle_tpu import unique_name
+from paddle_tpu.backward import append_backward
+from paddle_tpu.framework import Variable, default_startup_program, program_guard
+from paddle_tpu.initializer import ConstantInitializer
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.regularizer import append_regularization_ops
+from paddle_tpu import clip as clip_mod
+
+__all__ = [
+    "SGD", "Momentum", "LarsMomentum", "Adagrad", "Adam", "Adamax",
+    "DecayedAdagrad", "Adadelta", "RMSProp", "Ftrl",
+    "SGDOptimizer", "MomentumOptimizer", "LarsMomentumOptimizer",
+    "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
+    "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
+    "FtrlOptimizer", "Optimizer", "ModelAverage",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators = {}  # acc_name -> {param_name: var}
+        self._lr_var = None
+        self.helper = None
+
+    # -- learning rate -----------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        helper = LayerHelper("learning_rate")
+        self._lr_var = helper.create_global_variable(
+            name=unique_name.generate("learning_rate"),
+            shape=[1],
+            dtype="float32",
+            persistable=True,
+        )
+        helper.set_variable_initializer(
+            self._lr_var, ConstantInitializer(float(self._learning_rate))
+        )
+
+    def _global_learning_rate(self):
+        return self._lr_var
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        param_lr = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        if param_lr == 1.0:
+            return self._lr_var
+        helper = LayerHelper("param_lr")
+        out = helper.create_variable_for_type_inference(dtype="float32")
+        helper.append_op(
+            type="scale",
+            inputs={"X": [self._lr_var]},
+            outputs={"Out": [out]},
+            attrs={"scale": float(param_lr)},
+        )
+        return out
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper(name)
+        var = helper.create_global_variable(
+            name=unique_name.generate("%s_%s" % (param.name, name)),
+            shape=shape or list(param.shape),
+            dtype=dtype or param.dtype,
+            persistable=True,
+        )
+        helper.set_variable_initializer(var, ConstantInitializer(fill_value))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- main entry points (reference: optimizer.py:286,318,357) -----------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        block = params_grads[0][0].block.program.global_block()
+        self._create_global_learning_rate()
+
+        params_grads = clip_mod.append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(
+            params_grads, self.regularization
+        )
+
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        for param_and_grad in params_grads:
+            if param_and_grad[1] is None:
+                continue
+            self._append_optimize_op(block, param_and_grad)
+        self._finish_update(block, params_grads)
+        return params_grads
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        block.append_op(
+            type="sgd",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param]},
+        )
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        block.append_op(
+            type="momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference: optimizer.py:542, lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        block.append_op(
+            type="lars_momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+            },
+        )
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        block.append_op(
+            type="adagrad",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        block.append_op(
+            type="adam",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment1": [m1],
+                "Moment2": [m2],
+                "Beta1Pow": [b1p],
+                "Beta2Pow": [b2p],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "Moment1Out": [m1],
+                "Moment2Out": [m2],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+            },
+        )
+
+    def _finish_update(self, block, parameters_and_grads):
+        """Advance beta powers once per step
+        (reference: optimizer.py Adam._finish_update)."""
+        for param, grad in parameters_and_grads:
+            if grad is None:
+                continue
+            b1p = self._get_accumulator("beta1_pow_acc", param)
+            b2p = self._get_accumulator("beta2_pow_acc", param)
+            block.append_op(
+                type="scale",
+                inputs={"X": [b1p]},
+                outputs={"Out": [b1p]},
+                attrs={"scale": self._beta1},
+            )
+            block.append_op(
+                type="scale",
+                inputs={"X": [b2p]},
+                outputs={"Out": [b2p]},
+                attrs={"scale": self._beta2},
+            )
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        inf_norm = self._get_accumulator("inf_norm", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "InfNorm": [inf_norm],
+                "Beta1Pow": [b1p],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "MomentOut": [moment],
+                "InfNormOut": [inf_norm],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+            },
+        )
+
+    def _finish_update(self, block, parameters_and_grads):
+        for param, grad in parameters_and_grads:
+            if grad is None:
+                continue
+            b1p = self._get_accumulator("beta1_pow_acc", param)
+            block.append_op(
+                type="scale",
+                inputs={"X": [b1p]},
+                outputs={"Out": [b1p]},
+                attrs={"scale": self._beta1},
+            )
+
+
+class DecayedAdagrad(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        block.append_op(
+            type="decayed_adagrad",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("__avg_squared_grad", p)
+            self._add_accumulator("__avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        asg = self._get_accumulator("__avg_squared_grad", param)
+        asu = self._get_accumulator("__avg_squared_update", param)
+        block.append_op(
+            type="adadelta",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "AvgSquaredGrad": [asg],
+                "AvgSquaredUpdate": [asu],
+            },
+            outputs={
+                "ParamOut": [param],
+                "AvgSquaredGradOut": [asg],
+                "AvgSquaredUpdateOut": [asu],
+            },
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        block.append_op(
+            type="rmsprop",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [self._get_accumulator("momentum", param)],
+                "MeanSquare": [self._get_accumulator("mean_square", param)],
+                "MeanGrad": [self._get_accumulator("mean_grad", param)],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "MomentOut": [self._get_accumulator("momentum", param)],
+                "MeanSquareOut": [self._get_accumulator("mean_square", param)],
+                "MeanGradOut": [self._get_accumulator("mean_grad", param)],
+            },
+            attrs={
+                "decay": self._rho,
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "centered": self._centered,
+            },
+        )
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        block.append_op(
+            type="ftrl",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "SquaredAccumulator": [self._get_accumulator("squared", param)],
+                "LinearAccumulator": [self._get_accumulator("linear", param)],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "SquaredAccumOut": [self._get_accumulator("squared", param)],
+                "LinearAccumOut": [self._get_accumulator("linear", param)],
+            },
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class ModelAverage(Optimizer):
+    """Capability placeholder matching reference optimizer.py:1484 —
+    averaging windows over parameter history. Round-1: identity apply."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+
+    def minimize(self, loss, **kwargs):
+        raise NotImplementedError(
+            "ModelAverage applies to already-trained programs"
+        )
+
+
+# Reference-style aliases (fluid.optimizer.SGDOptimizer etc.)
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+LarsMomentumOptimizer = LarsMomentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl

@@ -1,0 +1,30 @@
+"""Legacy ParallelExecutor façade (reference:
+python/paddle/fluid/parallel_executor.py:41) over the SPMD CompiledProgram
+path — the C++ SSA-graph scheduler it used to wrap is replaced by one
+XLA-compiled SPMD program (see compiler.py)."""
+
+from paddle_tpu.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from paddle_tpu.executor import Executor, global_scope
+from paddle_tpu.framework import default_main_program
+from paddle_tpu.platform import default_accelerator_place
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        self._program = main_program or default_main_program()
+        self._scope = scope or global_scope()
+        self._executor = Executor(default_accelerator_place())
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name,
+            build_strategy=build_strategy,
+            exec_strategy=exec_strategy,
+            share_vars_from=getattr(share_vars_from, "_compiled", None),
+        )
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._compiled._run(
+            self._executor, feed, fetch_list, self._scope, return_numpy
+        )

@@ -1,0 +1,68 @@
+"""Places: device identity tags (reference: paddle/fluid/platform/place.h).
+
+The TPU build's Place variant is {CPUPlace, TPUPlace}; ``CUDAPlace`` is kept
+as an alias accepted for script compatibility (it selects the accelerator,
+which here is the TPU chip). Device binding is resolved lazily through JAX's
+backend — there is no dynload'd driver stack to manage (PJRT plays the role
+of the reference's platform/dynload layer).
+"""
+
+import jax
+
+
+class Place:
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items()))))
+
+
+class CPUPlace(Place):
+    def __repr__(self):
+        return "CPUPlace"
+
+    def jax_device(self):
+        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+        return cpus[0] if cpus else jax.devices()[0]
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return "TPUPlace(%d)" % self.device_id
+
+    def jax_device(self):
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CUDAPinnedPlace(CPUPlace):
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+# Script-compatibility alias: "the accelerator" is the TPU in this build.
+CUDAPlace = TPUPlace
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def default_accelerator_place():
+    devs = jax.devices()
+    if devs and devs[0].platform != "cpu":
+        return TPUPlace(0)
+    return CPUPlace()
+
+
+def cuda_device_count():
+    """Accelerator count (name kept for API compat)."""
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or 1

@@ -1,0 +1,51 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py — profiler ctx
+mgr:221, start/stop_profiler:125,165, cuda_profiler:39) — backed by the JAX
+profiler, whose traces load in TensorBoard/XProf (the XPlane equivalent of
+the reference's CUPTI + chrome-trace pipeline, SURVEY.md §5)."""
+
+import contextlib
+import os
+
+import jax
+
+_trace_dir = None
+
+
+def start_profiler(state="All", tracer_option=None):
+    global _trace_dir
+    _trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+    jax.profiler.start_trace(_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()
+    if _trace_dir:
+        print("profiler trace written to %s (open with TensorBoard)" % _trace_dir)
+
+
+def reset_profiler():
+    pass
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option=None):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Accelerator profiler passthrough (name kept for API compat)."""
+    with profiler():
+        yield
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RAII span (reference: platform/profiler.h:82 RecordEvent)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,21 @@
+"""Test harness configuration: force the JAX CPU backend with 8 virtual
+devices so multi-chip SPMD paths are exercised without TPU hardware — the
+equivalent of the reference's multi-process-on-localhost cluster simulation
+(reference: tests/unittests/test_dist_base.py), per SURVEY.md §4."""
+
+import os
+
+# Override unconditionally: the driver environment presets JAX_PLATFORMS to
+# the real TPU platform; tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# jax may already be imported by a pytest plugin, in which case it captured
+# the driver's JAX_PLATFORMS (the real TPU); force the config directly.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

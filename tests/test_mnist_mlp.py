@@ -1,0 +1,93 @@
+"""End-to-end MNIST-style MLP training — the reference's first "book" test
+(reference: tests/book/test_recognize_digits.py) on synthetic separable data:
+asserts the loss trajectory decreases and accuracy rises."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def build_mlp():
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=128, act="relu")
+    h2 = fluid.layers.fc(input=h, size=64, act="relu")
+    pred = fluid.layers.fc(input=h2, size=10, act=None)
+    loss = fluid.layers.softmax_with_cross_entropy(logits=pred, label=label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=pred, label=label)
+    return img, label, avg_loss, acc
+
+
+def synth_batches(n_steps, batch=64, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(784, 10).astype(np.float32)
+    for _ in range(n_steps):
+        x = rng.randn(batch, 784).astype(np.float32)
+        y = np.argmax(x @ W, axis=1).astype(np.int64).reshape(batch, 1)
+        yield x, y
+
+
+def test_mnist_mlp_converges():
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        img, label, avg_loss, acc = build_mlp()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses, accs = [], []
+        for x, y in synth_batches(200):
+            l, a = exe.run(main, feed={"img": x, "label": y},
+                           fetch_list=[avg_loss, acc])
+            losses.append(float(l))
+            accs.append(float(np.asarray(a).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    assert np.mean(accs[-10:]) > np.mean(accs[:10]) + 0.1
+
+
+def test_mnist_mlp_adam_and_eval_program():
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        img, label, avg_loss, acc = build_mlp()
+        test_program = main.clone(for_test=True)
+        opt = fluid.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for x, y in synth_batches(40, seed=1):
+            exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_loss])
+        # eval on the cloned test program shares the trained params
+        xs, ys = next(iter(synth_batches(1, batch=128, seed=2)))
+        (test_loss,) = exe.run(test_program, feed={"img": xs, "label": ys},
+                               fetch_list=[avg_loss])
+        assert np.isfinite(float(test_loss))
+
+
+def test_momentum_optimizer():
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        img, label, avg_loss, acc = build_mlp()
+        opt = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for x, y in synth_batches(40, seed=3):
+            (l,) = exe.run(main, feed={"img": x, "label": y},
+                           fetch_list=[avg_loss])
+            losses.append(float(l))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,237 @@
+"""Per-op numeric parity tests vs numpy (reference methodology:
+tests/unittests/test_mul_op.py, test_elementwise_add_op.py, ...)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestMulOp(OpTest):
+    def test_output(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        y = np.random.rand(5, 3).astype(np.float32)
+        self.check_output(
+            "mul",
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": x @ y},
+            attrs={"x_num_col_dims": 1, "y_num_col_dims": 1},
+        )
+
+    def test_flatten(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(12, 5).astype(np.float32)
+        self.check_output(
+            "mul",
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": (x.reshape(2, 12) @ y).reshape(2, 5)},
+            attrs={"x_num_col_dims": 1, "y_num_col_dims": 1},
+        )
+
+    def test_grad(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4, 2).astype(np.float32)
+        self.check_grad(
+            "mul", {"X": [("x", x)], "Y": [("y", y)]}, "x",
+            attrs={"x_num_col_dims": 1, "y_num_col_dims": 1},
+        )
+
+
+class TestMatmulOp(OpTest):
+    def test_transpose(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        y = np.random.rand(3, 5).astype(np.float32)
+        self.check_output(
+            "matmul",
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": x @ y.T},
+            attrs={"transpose_X": False, "transpose_Y": True, "alpha": 1.0},
+        )
+
+    def test_batched(self):
+        x = np.random.rand(2, 4, 5).astype(np.float32)
+        y = np.random.rand(2, 5, 3).astype(np.float32)
+        self.check_output(
+            "matmul",
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": np.matmul(x, y)},
+            attrs={},
+        )
+
+
+class TestElementwise(OpTest):
+    def test_add_broadcast_axis(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(3).astype(np.float32)
+        self.check_output(
+            "elementwise_add",
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": x + y.reshape(1, 3, 1)},
+            attrs={"axis": 1},
+        )
+
+    def test_sub_same_shape(self):
+        x = np.random.rand(5, 6).astype(np.float32)
+        y = np.random.rand(5, 6).astype(np.float32)
+        self.check_output(
+            "elementwise_sub",
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": x - y},
+        )
+
+    def test_mul_grad(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        self.check_grad(
+            "elementwise_mul", {"X": [("x", x)], "Y": [("y", y)]}, "y"
+        )
+
+
+class TestActivations(OpTest):
+    def test_relu(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        self.check_output("relu", {"X": [("x", x)]}, {"Out": np.maximum(x, 0)})
+
+    def test_sigmoid(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        self.check_output(
+            "sigmoid", {"X": [("x", x)]}, {"Out": 1 / (1 + np.exp(-x))},
+            atol=1e-6,
+        )
+
+    def test_tanh_grad(self):
+        x = np.random.randn(3, 3).astype(np.float32)
+        self.check_grad("tanh", {"X": [("x", x)]}, "x")
+
+    def test_softmax(self):
+        x = np.random.randn(4, 7).astype(np.float32)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.check_output(
+            "softmax", {"X": [("x", x)]}, {"Out": e / e.sum(-1, keepdims=True)},
+            atol=1e-6,
+        )
+
+    def test_gelu(self):
+        import math
+
+        x = np.random.randn(4, 5).astype(np.float32)
+        expected = np.asarray(
+            [0.5 * v * (1 + math.erf(v / math.sqrt(2))) for v in x.flatten()],
+            dtype=np.float32,
+        ).reshape(x.shape)
+        self.check_output("gelu", {"X": [("x", x)]}, {"Out": expected},
+                          atol=1e-5)
+
+
+class TestReduce(OpTest):
+    def test_reduce_sum(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        self.check_output(
+            "reduce_sum", {"X": [("x", x)]}, {"Out": x.sum(axis=1)},
+            attrs={"dim": [1], "keep_dim": False, "reduce_all": False},
+            atol=1e-5,
+        )
+
+    def test_reduce_mean_all(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.check_output(
+            "reduce_mean", {"X": [("x", x)]}, {"Out": x.mean()},
+            attrs={"dim": [0], "keep_dim": False, "reduce_all": True},
+            atol=1e-6,
+        )
+
+    def test_reduce_max(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.check_output(
+            "reduce_max", {"X": [("x", x)]}, {"Out": x.max(axis=0)},
+            attrs={"dim": [0], "keep_dim": False, "reduce_all": False},
+        )
+
+
+class TestLossOps(OpTest):
+    def test_softmax_with_cross_entropy(self):
+        logits = np.random.randn(8, 10).astype(np.float32)
+        label = np.random.randint(0, 10, (8, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        expected_loss = -np.log(
+            sm[np.arange(8), label.flatten()]
+        ).reshape(8, 1).astype(np.float32)
+        got = self.run_op(
+            "softmax_with_cross_entropy",
+            {"Logits": [("logits", logits)], "Label": [("label", label)]},
+            {"Softmax": 1, "Loss": 1},
+            attrs={"soft_label": False},
+            fetch=["softmax_out_0", "loss_out_0"],
+        )
+        np.testing.assert_allclose(got["softmax_out_0"], sm, atol=1e-5)
+        np.testing.assert_allclose(got["loss_out_0"], expected_loss, atol=1e-5)
+
+    def test_cross_entropy(self):
+        probs = np.random.rand(6, 5).astype(np.float32) + 0.1
+        probs /= probs.sum(-1, keepdims=True)
+        label = np.random.randint(0, 5, (6, 1)).astype(np.int64)
+        expected = -np.log(
+            probs[np.arange(6), label.flatten()]
+        ).reshape(6, 1).astype(np.float32)
+        got = self.run_op(
+            "cross_entropy",
+            {"X": [("x", probs)], "Label": [("label", label)]},
+            {"Y": 1},
+            attrs={"soft_label": False},
+            fetch=["y_out_0"],
+        )
+        np.testing.assert_allclose(got["y_out_0"], expected, atol=1e-5)
+
+    def test_mean(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        self.check_output("mean", {"X": [("x", x)]}, {"Out": x.mean()},
+                          atol=1e-6)
+
+
+class TestTensorOps(OpTest):
+    def test_concat(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 4).astype(np.float32)
+        self.check_output(
+            "concat",
+            {"X": [("a", a), ("b", b)]},
+            {"Out": np.concatenate([a, b], axis=1)},
+            attrs={"axis": 1},
+        )
+
+    def test_cast(self):
+        x = np.random.rand(3, 3).astype(np.float32)
+        self.check_output(
+            "cast", {"X": [("x", x)]}, {"Out": x.astype(np.int32)},
+            attrs={"in_dtype": 5, "out_dtype": 2},
+        )
+
+    def test_transpose2(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        got = self.run_op(
+            "transpose2", {"X": [("x", x)]}, {"Out": 1, "XShape": 1},
+            attrs={"axis": [0, 2, 1]},
+            fetch=["out_out_0"],
+        )
+        np.testing.assert_allclose(got["out_out_0"], x.transpose(0, 2, 1))
+
+    def test_gather(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4], dtype=np.int32)
+        self.check_output(
+            "gather",
+            {"X": [("x", x)], "Index": [("idx", idx)]},
+            {"Out": x[idx]},
+        )
+
+    def test_lookup_table(self):
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.array([[1], [3], [7]], dtype=np.int64)
+        self.check_output(
+            "lookup_table",
+            {"W": [("w", w)], "Ids": [("ids", ids)]},
+            {"Out": w[ids.flatten()]},
+            attrs={"padding_idx": -1},
+        )
+
