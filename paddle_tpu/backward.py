@@ -46,8 +46,22 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
     """Append gradient ops for ``loss``; returns [(param, grad_var)]
     (reference: backward.py:394)."""
+    from paddle_tpu.framework import OpRole
+
     block = loss.block
     program = block.program
+    # Every op appended below is gradient machinery: stamp it Backward so
+    # clone(for_test=True) prunes it (reference: backward.py:394 op_role).
+    with program._op_role_guard(OpRole.Backward):
+        return _append_backward_impl(
+            loss, block, program, parameter_list, no_grad_set, callbacks
+        )
+
+
+def _append_backward_impl(loss, block, program, parameter_list, no_grad_set,
+                          callbacks):
+    from paddle_tpu.framework import OpRole
+
     no_grad = _collect_no_grad(block, no_grad_set)
 
     path = _find_op_path(block, loss.name, no_grad)
@@ -187,6 +201,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 ]
 
         attrs = dict(op.attrs)
+        attrs["op_role"] = OpRole.Backward
         attrs["__fwd_inputs__"] = sorted(op.inputs.keys())
         attrs["__fwd_outputs__"] = sorted(op.outputs.keys())
         if "__rng_id__" not in attrs:

@@ -44,13 +44,41 @@ class BlockProgram:
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
 
+        all_ops = [op for op in block.ops if op.type not in _SKIP_OPS]
+
+        # Dead-code elimination over the block's dataflow (the XLA-native
+        # analog of the reference's program pruning, framework/prune.cc /
+        # io.py:862): an op is live iff it feeds a fetch target, writes a
+        # persistable var (param/optimizer-state/BN-stat side effect), or has
+        # no outputs at all (pure side effect). Fetching `pred` from a
+        # for_test clone therefore no longer demands `label` nor computes the
+        # loss subgraph.
+        def _is_persistable(name):
+            vd = block.find_var_recursive(name)
+            return vd is not None and vd.persistable
+
+        live_vars = set(self.fetch_names) | set(extra_state_outputs)
+        live_flags = [False] * len(all_ops)
+        for i in range(len(all_ops) - 1, -1, -1):
+            op = all_ops[i]
+            outs = [n for n in op.output_arg_names() if n != EMPTY_VAR_NAME]
+            live = (
+                not outs
+                or any(n in live_vars for n in outs)
+                or any(_is_persistable(n) for n in outs)
+            )
+            if live:
+                live_flags[i] = True
+                for n in op.input_arg_names():
+                    if n != EMPTY_VAR_NAME:
+                        live_vars.add(n)
+        self.ops = [op for i, op in enumerate(all_ops) if live_flags[i]]
+
         feed_set = set(self.feed_names)
         written = set()
         state_in = []  # vars read before written, provided by scope
         state_in_set = set()
-        for op in block.ops:
-            if op.type in _SKIP_OPS:
-                continue
+        for op in self.ops:
             for name in op.input_arg_names():
                 if (
                     name != EMPTY_VAR_NAME
@@ -63,13 +91,22 @@ class BlockProgram:
             for name in op.output_arg_names():
                 written.add(name)
 
+        # A fetch of a var no live op writes (e.g. fetching a parameter
+        # directly to inspect it) is served from the scope like other state.
+        for name in self.fetch_names:
+            if (
+                name not in written
+                and name not in feed_set
+                and name not in state_in_set
+            ):
+                state_in.append(name)
+                state_in_set.add(name)
+
         # Outputs: every persistable var written + anything fetched + explicit
         # extras (e.g. params the caller wants synced even if only aliased).
         state_out = []
         seen = set()
-        for op in block.ops:
-            if op.type in _SKIP_OPS:
-                continue
+        for op in self.ops:
             for name in op.output_arg_names():
                 if name in seen:
                     continue
@@ -90,7 +127,7 @@ class BlockProgram:
         # "holder should not be null" enforce.
         self.needs_rng = any(
             OpRegistry.has(_base_type(op.type)) and _op_needs_rng(op)
-            for op in block.ops
+            for op in self.ops
         )
 
 
@@ -119,9 +156,7 @@ def lower_block(block_program, is_test=False, executor=None):
         for name, val in zip(state_in_names, state_values):
             env[name] = val
 
-        for op_index, op in enumerate(block.ops):
-            if op.type in _SKIP_OPS:
-                continue
+        for op_index, op in enumerate(block_program.ops):
             run_op(op, block, env, rng_key, op_index, is_test, executor)
 
         fetches = [env[n] for n in block_program.fetch_names]

@@ -8,6 +8,8 @@ instead of per-op C++ InferShape (reference: operator.cc:586
 RuntimeInferShapeContext).
 """
 
+import contextlib
+
 import numpy as np
 
 import jax
@@ -328,12 +330,34 @@ class Block:
         return p
 
     def append_op(self, type=None, inputs=None, outputs=None, attrs=None):
+        attrs = dict(attrs or {})
+        if OP_ROLE_KEY not in attrs:
+            attrs[OP_ROLE_KEY] = self.program._current_role
+        if self.program._op_role_var and OP_ROLE_VAR_KEY not in attrs:
+            attrs[OP_ROLE_VAR_KEY] = list(self.program._op_role_var)
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.append(op)
         return op
 
     def all_parameters(self):
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+
+class OpRole:
+    """Op role bitmask stamped on every op (reference:
+    paddle/fluid/framework/op_proto_maker.h OpRole enum) — the basis for
+    ``clone(for_test=True)`` pruning and the transpilers' op classification."""
+
+    Forward = 0x0000
+    Backward = 0x0001
+    Optimize = 0x0002
+    RPC = 0x0004
+    Dist = 0x0008
+    LRSched = 0x0010
+    Loss = 0x0100
+
+OP_ROLE_KEY = "op_role"
+OP_ROLE_VAR_KEY = "op_role_var"
 
 
 class Program:
@@ -347,8 +371,35 @@ class Program:
         self._parameters = {}
         self._version = 0
         self._is_test = False
+        self._current_role = OpRole.Forward
+        self._op_role_var = []
         # sync token used by the engine's executable cache
         self.desc._version_token = 0
+
+    @contextlib.contextmanager
+    def _op_role_guard(self, role):
+        prev = self._current_role
+        self._current_role = role
+        try:
+            yield
+        finally:
+            self._current_role = prev
+
+    @contextlib.contextmanager
+    def _optimized_guard(self, param_and_grad):
+        """(reference: framework.py Program._optimized_guard)"""
+        prev_role = self._current_role
+        prev_var = self._op_role_var
+        self._current_role = OpRole.Optimize
+        self._op_role_var = [
+            v.name if hasattr(v, "name") else v
+            for v in param_and_grad if v is not None
+        ]
+        try:
+            yield
+        finally:
+            self._current_role = prev_role
+            self._op_role_var = prev_var
 
     def _bump_version(self):
         self._version += 1
@@ -413,6 +464,17 @@ class Program:
         new._bump_version()
         if for_test:
             new._is_test = True
+            # Drop backward + optimize ops (reference: framework.py
+            # Program.clone(for_test=True) → _inference_optimize pruning by
+            # op_role) so a test-program run never touches parameters.
+            for bd in new.desc.blocks:
+                bd.ops = [
+                    op for op in bd.ops
+                    if not (
+                        int(op.attrs.get(OP_ROLE_KEY, 0))
+                        & (OpRole.Backward | OpRole.Optimize)
+                    )
+                ]
             _flip_is_test(new.desc)
         return new
 

@@ -98,12 +98,14 @@ def _prune_for_inference(program, feed_names, fetch_names):
     src = program.desc.global_block()
     needed = set(fetch_names)
     keep = []
+    from paddle_tpu.framework import OP_ROLE_KEY, OpRole
+
     for i in range(len(src.ops) - 1, -1, -1):
         op = src.ops[i]
-        if op.type.endswith("_grad") or op.type in (
-            "sgd", "momentum", "adam", "adamax", "adagrad", "rmsprop",
-            "adadelta", "ftrl", "lars_momentum", "decayed_adagrad",
-        ):
+        # Classify by the op_role bit every op now carries, like
+        # clone(for_test=True) (reference: op_proto_maker.h OpRole).
+        role = int(op.attrs.get(OP_ROLE_KEY, 0))
+        if role & (OpRole.Backward | OpRole.Optimize):
             continue
         if any(n in needed for n in op.output_arg_names()):
             keep.append(i)

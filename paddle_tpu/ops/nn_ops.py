@@ -58,25 +58,40 @@ def depthwise_conv2d(ctx, ins, attrs):
 
 @register_op("conv2d_transpose")
 def conv2d_transpose(ctx, ins, attrs):
+    """Gradient-style transposed conv: input-dilate by stride, convolve with
+    the spatially-flipped, IO-swapped kernel (reference semantics:
+    paddle/fluid/operators/conv_transpose_op.cc; output size
+    (H-1)*s - 2p + d*(k-1) + 1)."""
     x = single(ins, "Input")  # NCHW
-    w = single(ins, "Filter")  # IOHW in paddle transpose convs
+    w = single(ins, "Filter")  # IOHW (I = C_in, O = C_out/groups)
     strides = tuple(attrs.get("strides", [1, 1]))
     paddings = attrs.get("paddings", [0, 0])
     dilations = tuple(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
-    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
-    out = lax.conv_transpose(
-        x,
-        w,
-        strides=strides,
+
+    c_in, o_g, kh, kw = w.shape
+    # IOHW -> OIHW with grouping: (g, C_in/g, O_g, kh, kw) -> (g*O_g, C_in/g,)
+    w_ = w.reshape(groups, c_in // groups, o_g, kh, kw)
+    w_ = jnp.transpose(w_, (0, 2, 1, 3, 4)).reshape(
+        groups * o_g, c_in // groups, kh, kw)
+    w_ = jnp.flip(w_, axis=(2, 3))
+
+    pad = [
+        (dilations[0] * (kh - 1) - paddings[0],
+         dilations[0] * (kh - 1) - paddings[0]),
+        (dilations[1] * (kw - 1) - paddings[1],
+         dilations[1] * (kw - 1) - paddings[1]),
+    ]
+    dn = lax.conv_dimension_numbers(x.shape, w_.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w_,
+        window_strides=(1, 1),
         padding=pad,
+        lhs_dilation=strides,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
+        dimension_numbers=dn,
+        feature_group_count=groups,
     )
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
     return {"Output": [out]}
 
 

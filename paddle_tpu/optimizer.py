@@ -103,20 +103,27 @@ class Optimizer:
         return append_backward(loss, parameter_list, no_grad_set, callbacks)
 
     def apply_gradients(self, params_grads):
-        block = params_grads[0][0].block.program.global_block()
-        self._create_global_learning_rate()
+        from paddle_tpu.framework import OpRole
 
-        params_grads = clip_mod.append_gradient_clip_ops(params_grads)
-        params_grads = append_regularization_ops(
-            params_grads, self.regularization
-        )
+        program = params_grads[0][0].block.program
+        block = program.global_block()
+        # All update machinery is Optimize-role: pruned from for_test clones
+        # (reference: optimizer.py apply_gradients under _optimized_guard).
+        with program._op_role_guard(OpRole.Optimize):
+            self._create_global_learning_rate()
 
-        self._create_accumulators(block, [p for p, _ in params_grads])
-        for param_and_grad in params_grads:
-            if param_and_grad[1] is None:
-                continue
-            self._append_optimize_op(block, param_and_grad)
-        self._finish_update(block, params_grads)
+            params_grads = clip_mod.append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(
+                params_grads, self.regularization
+            )
+
+            self._create_accumulators(block, [p for p, _ in params_grads])
+            for param_and_grad in params_grads:
+                if param_and_grad[1] is None:
+                    continue
+                with program._optimized_guard(param_and_grad):
+                    self._append_optimize_op(block, param_and_grad)
+            self._finish_update(block, params_grads)
         return params_grads
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
