@@ -34,6 +34,11 @@ from paddle_tpu.platform import (  # noqa: F401
     is_compiled_with_cuda,
     is_compiled_with_tpu,
 )
+from paddle_tpu.layers.control_flow import (  # noqa: F401
+    While,
+    StaticRNN,
+    Switch,
+)
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from paddle_tpu.backward import append_backward, calc_gradient  # noqa: F401
 from paddle_tpu.data_feeder import DataFeeder  # noqa: F401

@@ -1,5 +1,15 @@
 from paddle_tpu.layers.tensor import *  # noqa: F401,F403
 from paddle_tpu.layers.nn import *  # noqa: F401,F403
+from paddle_tpu.layers.control_flow import (  # noqa: F401
+    While,
+    StaticRNN,
+    Switch,
+    create_array,
+    array_write,
+    array_read,
+    array_length,
+    increment,
+)
 from paddle_tpu.layers.ops import *  # noqa: F401,F403
 from paddle_tpu.layers.io import data  # noqa: F401
 from paddle_tpu.layers.loss import *  # noqa: F401,F403

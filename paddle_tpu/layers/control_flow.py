@@ -1,0 +1,378 @@
+"""Structured control-flow layers: While, StaticRNN, Switch, tensor arrays.
+
+Reference: python/paddle/fluid/layers/control_flow.py (While:687,
+StaticRNN:317, Switch:1108, array_write/array_read/array_length,
+increment:1022, less_than). The builder API is kept; the execution story is
+TPU-native: sub-blocks trace into the same XLA computation as
+``lax.while_loop`` / ``lax.scan`` / branch-select (see
+paddle_tpu/ops/controlflow_ops.py) instead of nested interpreters over kid
+scopes (reference: operators/controlflow/while_op.cc StepScopes).
+"""
+
+import contextlib
+
+from paddle_tpu import unique_name
+from paddle_tpu.framework import default_main_program, Variable
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+
+def _resolvable_in_ancestors(program, sub_block, name):
+    """True if ``name`` resolves in a block strictly above ``sub_block``."""
+    b = sub_block
+    while b.parent_idx != -1:
+        b = program.block(b.parent_idx)
+        if name in b.vars:
+            return True
+    return False
+
+
+def _analyze_sub_block(program, sub_block):
+    """Ordered external reads and external writes of a sub-block.
+
+    External = resolved from an ancestor block (parameters, loop state,
+    arrays), not created locally in the sub-block.
+    """
+    reads, writes = [], []
+    read_set, write_set = set(), set()
+    written = set()
+    for op in sub_block.desc.ops:
+        for n in op.input_arg_names():
+            if (
+                n
+                and n not in written
+                and n not in sub_block.vars
+                and n not in read_set
+                and _resolvable_in_ancestors(program, sub_block, n)
+            ):
+                reads.append(n)
+                read_set.add(n)
+        for n in op.output_arg_names():
+            written.add(n)
+            if (
+                n
+                and n not in sub_block.vars
+                and n not in write_set
+                and _resolvable_in_ancestors(program, sub_block, n)
+            ):
+                writes.append(n)
+                write_set.add(n)
+    return reads, writes
+
+
+class While:
+    """``with While(cond).block():`` — loop while ``cond`` (bool [1]) is true.
+
+    Everything written to an ancestor-block var inside the block is loop-
+    carried; such vars (including ``cond``) must be initialized before the
+    loop (reference: layers/control_flow.py:687).
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        if not isinstance(cond, Variable):
+            raise TypeError("While cond must be a Variable")
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+
+        reads, writes = _analyze_sub_block(program, sub_block)
+        out_names = [n for n in writes if n != self.cond_var.name]
+        # every loop-carried output needs its initial value in X, plus all
+        # read-only externals
+        x_names = list(dict.fromkeys(reads + out_names))
+
+        step_scopes = parent_block.create_var(
+            name=unique_name.generate("while_step_scopes"))
+        parent_block.append_op(
+            type="while",
+            inputs={"X": x_names, "Condition": [self.cond_var.name]},
+            outputs={"Out": out_names + [self.cond_var.name],
+                     "StepScopes": [step_scopes.name]},
+            attrs={"sub_block": sub_block.desc.idx, "is_test": False},
+        )
+
+
+class StaticRNN:
+    """Time-major recurrence builder lowered to one differentiable
+    ``lax.scan`` (reference: layers/control_flow.py StaticRNN:317 →
+    operators/recurrent_op.cc).
+
+    Inputs fed via ``step_input`` must be [T, ...] (time-major)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._inputs = []      # (parent_var, sub_var)
+        self._memories = []    # (init_parent_var, mem_sub_var)
+        self._mem_updates = {}  # mem sub name -> updated var name
+        self._step_outputs = []  # sub-block vars
+        self._outputs = []       # parent stacked vars
+        self._sub_block = None
+        self._parent_block = None
+        self._complete = False
+        self._seq_len = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent_block = program.current_block()
+        self._sub_block = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+            self._complete_op()
+
+    def step_input(self, x):
+        if x.shape is None or len(x.shape) < 1:
+            raise ValueError("step_input must have a time-major shape [T,...]")
+        if self._seq_len is None:
+            self._seq_len = x.shape[0]
+        sub = self.helper.main_program.current_block()
+        ipt = sub.create_var(
+            name=unique_name.generate("rnn_input"),
+            shape=list(x.shape[1:]),
+            dtype=x.dtype,
+        )
+        self._inputs.append((x, ipt))
+        return ipt
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               dtype="float32"):
+        from paddle_tpu.layers import tensor as tensor_layers
+
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError(
+                    "memory needs either init= or (shape= and batch_ref=)")
+            # build the init var in the PARENT block
+            prog = self.helper.main_program
+            cur = prog.current_block_idx
+            prog.current_block_idx = self._parent_block.idx
+            try:
+                init = tensor_layers.fill_constant_batch_size_like(
+                    input=batch_ref, shape=[-1] + list(shape),
+                    dtype=dtype, value=init_value)
+            finally:
+                prog.current_block_idx = cur
+        sub = self.helper.main_program.current_block()
+        mem = sub.create_var(
+            name=unique_name.generate("rnn_memory"),
+            shape=list(init.shape) if init.shape else None,
+            dtype=init.dtype,
+        )
+        self._memories.append((init, mem))
+        return mem
+
+    def update_memory(self, mem, new):
+        self._mem_updates[mem.name] = new.name
+
+    def step_output(self, o):
+        self._step_outputs.append(o)
+        out = self._parent_block.create_var(
+            name=unique_name.generate("rnn_output"),
+            shape=([self._seq_len] + list(o.shape)) if o.shape is not None
+            else None,
+            dtype=o.dtype,
+        )
+        self._outputs.append(out)
+        return out
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete_op(self):
+        if self._complete:
+            return
+        self._complete = True
+        program = self.helper.main_program
+        sub = self._sub_block
+        parent = self._parent_block
+
+        reads, _ = _analyze_sub_block(program, sub)
+        input_names = {i.name for _, i in self._inputs}
+        mem_names = {m.name for _, m in self._memories}
+        params = [
+            n for n in reads
+            if n not in input_names and n not in mem_names
+            and n not in {x.name for x, _ in self._inputs}
+            and n not in {iv.name for iv, _ in self._memories}
+        ]
+
+        finals = [
+            parent.create_var(
+                name=unique_name.generate("rnn_final_state"),
+                shape=list(iv.shape) if iv.shape else None, dtype=iv.dtype)
+            for iv, _ in self._memories
+        ]
+        for m, _ in zip((m for _, m in self._memories), finals):
+            if m.name not in self._mem_updates:
+                raise RuntimeError(
+                    "StaticRNN memory %r was never update_memory()'d" % m.name)
+
+        parent.append_op(
+            type="recurrent",
+            inputs={
+                "Inputs": [x.name for x, _ in self._inputs],
+                "InitStates": [iv.name for iv, _ in self._memories],
+                "Params": params,
+            },
+            outputs={
+                "Outputs": [o.name for o in self._outputs],
+                "FinalStates": [f.name for f in finals],
+            },
+            attrs={
+                "sub_block": sub.desc.idx,
+                "input_vars": [i.name for _, i in self._inputs],
+                "ex_state_vars": [m.name for _, m in self._memories],
+                "state_vars": [
+                    self._mem_updates[m.name] for _, m in self._memories
+                ],
+                "output_vars": [o.name for o in self._step_outputs],
+            },
+        )
+
+    def __call__(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return list(self._outputs)
+
+
+class Switch:
+    """``with switch.case(cond):`` cascade; each case body's writes take
+    effect only when its condition is the first true one (reference:
+    layers/control_flow.py Switch:1108, used by LR schedulers). Written vars
+    must be pre-initialized (their value when no case matches)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._prev_conds = []
+
+    @contextlib.contextmanager
+    def _guarded_block(self, cond_var):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        reads, writes = _analyze_sub_block(program, sub_block)
+        x_names = list(dict.fromkeys(reads + writes))
+        scope_var = parent_block.create_var(
+            name=unique_name.generate("cond_scope"))
+        parent_block.append_op(
+            type="conditional_block",
+            inputs={"Cond": [cond_var.name], "Input": x_names},
+            outputs={"Out": writes, "Scope": [scope_var.name]},
+            attrs={"sub_block": sub_block.desc.idx},
+        )
+
+    def case(self, condition):
+        from paddle_tpu.layers import nn as nn_layers
+
+        not_prev = None
+        for c in self._prev_conds:
+            nc = nn_layers.logical_not(c)
+            not_prev = nc if not_prev is None else nn_layers.logical_and(
+                not_prev, nc)
+        self._prev_conds.append(condition)
+        eff = condition if not_prev is None else nn_layers.logical_and(
+            condition, not_prev)
+        return self._guarded_block(eff)
+
+    def default(self):
+        from paddle_tpu.layers import nn as nn_layers
+
+        assert self._prev_conds, "default() requires at least one case"
+        not_prev = None
+        for c in self._prev_conds:
+            nc = nn_layers.logical_not(c)
+            not_prev = nc if not_prev is None else nn_layers.logical_and(
+                not_prev, nc)
+        return self._guarded_block(not_prev)
+
+
+# -- tensor array + loop utility layers ------------------------------------
+
+def create_array(dtype="float32", capacity=None):
+    """LoDTensorArray-equivalent: fixed-capacity stacked buffer
+    (reference: layers/control_flow.py create_array)."""
+    helper = LayerHelper("create_array")
+    arr = helper.block.create_var(
+        name=unique_name.generate("array"), dtype=dtype)
+    attrs = {}
+    if capacity is not None:
+        attrs["capacity"] = int(capacity)
+    helper.append_op(
+        type="create_array", inputs={}, outputs={"Out": [arr.name]},
+        attrs=attrs)
+    arr._array_capacity = capacity
+    return arr
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(dtype=x.dtype)
+    attrs = {}
+    cap = getattr(array, "_array_capacity", None)
+    if cap is not None:
+        attrs["capacity"] = int(cap)
+    helper.append_op(
+        type="write_to_array",
+        inputs={"X": [x.name], "I": [i.name], "Array": [array.name]},
+        outputs={"Out": [array.name]},
+        attrs=attrs,
+    )
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.block.create_var(
+        name=unique_name.generate("array_read"), dtype=array.dtype)
+    helper.append_op(
+        type="read_from_array",
+        inputs={"X": [array.name], "I": [i.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.block.create_var(
+        name=unique_name.generate("array_len"), shape=[1], dtype="int64")
+    helper.append_op(
+        type="lod_array_length",
+        inputs={"X": [array.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.block.create_var(
+            name=unique_name.generate("increment"),
+            shape=list(x.shape) if x.shape else None, dtype=x.dtype)
+    helper.append_op(
+        type="increment",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name]},
+        attrs={"step": float(value)},
+    )
+    return out

@@ -79,6 +79,10 @@ __all__ = [
     "not_equal",
     "less_equal",
     "greater_equal",
+    "logical_and",
+    "logical_or",
+    "logical_xor",
+    "logical_not",
 ]
 
 
@@ -1052,6 +1056,28 @@ less_than = _cmp_layer("less_than")
 less_equal = _cmp_layer("less_equal")
 greater_than = _cmp_layer("greater_than")
 greater_equal = _cmp_layer("greater_equal")
+
+
+def _logical_layer(op_type, unary=False):
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_variable_for_type_inference(dtype="bool")
+        inputs = {"X": [x]}
+        if not unary:
+            inputs["Y"] = [y]
+        helper.append_op(type=op_type, inputs=inputs,
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _logical_layer("logical_and")
+logical_or = _logical_layer("logical_or")
+logical_xor = _logical_layer("logical_xor")
+logical_not = _logical_layer("logical_not", unary=True)
 
 
 def where(condition, x, y):

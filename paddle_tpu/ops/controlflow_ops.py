@@ -1,9 +1,24 @@
-"""Compare/logical ops + control-flow glue
-(reference: paddle/fluid/operators/controlflow/)."""
+"""Compare/logical ops + structured control flow.
 
+Reference: paddle/fluid/operators/controlflow/ (while_op.cc,
+conditional_block_op.cc, compare_op.cc, logical_op.cc,
+tensor_array_read_write_op.cc) and operators/recurrent_op.cc.
+
+TPU-native design: the reference interprets sub-blocks with nested Executors
+and per-iteration kid Scopes (while_op.cc StepScopes); here a sub-block is
+traced into the SAME XLA computation as structured control flow —
+``lax.while_loop`` for `while`, ``lax.scan`` for `recurrent` (StaticRNN,
+reverse-differentiable so BPTT falls out of the generic vjp machinery), and
+branch-select for `conditional_block`. LoDTensorArray becomes a fixed-
+capacity ring of stacked tensors updated with dynamic_update_slice — the
+static-shape discipline XLA requires.
+"""
+
+import jax
 import jax.numpy as jnp
+from jax import lax
 
-from paddle_tpu.core.registry import register_no_grad_op
+from paddle_tpu.core.registry import register_op, register_no_grad_op
 from paddle_tpu.ops.common import single
 
 
@@ -47,3 +62,212 @@ def where_op(ctx, ins, attrs):
     x = single(ins, "X")
     y = single(ins, "Y")
     return {"Out": [jnp.where(cond, x, y)]}
+
+
+# ---------------------------------------------------------------------------
+# Sub-block execution helper shared by while/recurrent/conditional_block.
+# ---------------------------------------------------------------------------
+
+def _run_sub_block(ctx, sub_block, env):
+    """Trace every op of ``sub_block`` into ``env`` (name -> jax value)."""
+    from paddle_tpu.engine.lowering import run_op, _SKIP_OPS
+
+    for i, op in enumerate(sub_block.ops):
+        if op.type in _SKIP_OPS:
+            continue
+        run_op(op, sub_block, env, ctx._rng_key, 10_000 + i, ctx.is_test,
+               ctx.executor)
+    return env
+
+
+def _sub_block_of(ctx, attrs):
+    return ctx.block.program.block(int(attrs["sub_block"]))
+
+
+# ---------------------------------------------------------------------------
+# while — lax.while_loop (reference: controlflow/while_op.cc). Forward-only
+# (used for decode loops); training-time recurrence is the `recurrent` op.
+# ---------------------------------------------------------------------------
+
+@register_no_grad_op("while")
+def while_op(ctx, ins, attrs):
+    sub = _sub_block_of(ctx, attrs)
+    x_names = list(ctx.op.inputs.get("X", []))
+    x_vals = ins.get("X", [])
+    cond_name = ctx.op.inputs["Condition"][0]
+    cond0 = single(ins, "Condition")
+    out_names = list(ctx.op.outputs.get("Out", []))
+
+    base_env = dict(zip(x_names, x_vals))
+    base_env[cond_name] = cond0
+    # Loop-carried values: the condition + every declared output. An output's
+    # initial value must be available from X (the Python builder guarantees
+    # writes-before-loop for anything read in iteration 0).
+    missing = [n for n in out_names if n not in base_env]
+    if missing:
+        raise RuntimeError(
+            "while op: loop-carried vars %r have no initial value; "
+            "initialize them before the loop (reference semantics: "
+            "while_op.cc reads outside vars from the parent scope)" % missing
+        )
+    init_carry = (
+        jnp.reshape(cond0, ()).astype(jnp.bool_),
+        tuple(base_env[n] for n in out_names),
+    )
+
+    def cond_fn(carry):
+        return carry[0]
+
+    def body_fn(carry):
+        env = dict(base_env)
+        env.update(zip(out_names, carry[1]))
+        env[cond_name] = carry[0]
+        _run_sub_block(ctx, sub, env)
+        return (
+            jnp.reshape(env[cond_name], ()).astype(jnp.bool_),
+            tuple(env[n] for n in out_names),
+        )
+
+    final = lax.while_loop(cond_fn, body_fn, init_carry)
+    return {"Out": list(final[1]), "StepScopes": []}
+
+
+# ---------------------------------------------------------------------------
+# conditional_block — both branches trace, outputs branch-selected (XLA
+# prefers select over divergent control flow for cheap bodies; reference:
+# controlflow/conditional_block_op.cc runs the block only when cond is true).
+# ---------------------------------------------------------------------------
+
+@register_op("conditional_block")
+def conditional_block(ctx, ins, attrs):
+    sub = _sub_block_of(ctx, attrs)
+    x_names = list(ctx.op.inputs.get("Input", []))
+    x_vals = ins.get("Input", [])
+    cond = single(ins, "Cond")
+    out_names = list(ctx.op.outputs.get("Out", []))
+
+    env = dict(zip(x_names, x_vals))
+    init = {}
+    for n in out_names:
+        if n not in env:
+            raise RuntimeError(
+                "conditional_block output %r must be initialized before the "
+                "block (its value when the condition is false)" % n
+            )
+        init[n] = env[n]
+    _run_sub_block(ctx, sub, env)
+    flag = jnp.reshape(cond, ()).astype(jnp.bool_)
+    outs = [
+        jnp.where(flag, env[n].astype(init[n].dtype), init[n])
+        for n in out_names
+    ]
+    return {"Out": outs, "Scope": []}
+
+
+# ---------------------------------------------------------------------------
+# recurrent — lax.scan over the time-major axis; reverse-differentiable, so
+# StaticRNN training (BPTT) needs no hand-written grad (reference:
+# operators/recurrent_op.cc + recurrent_op gradient).
+# ---------------------------------------------------------------------------
+
+@register_op("recurrent")
+def recurrent(ctx, ins, attrs):
+    sub = _sub_block_of(ctx, attrs)
+    input_vars = list(attrs.get("input_vars", []))      # sub-block names, x[t]
+    ex_state_vars = list(attrs.get("ex_state_vars", []))  # state at t-1
+    state_vars = list(attrs.get("state_vars", []))        # state at t
+    output_vars = list(attrs.get("output_vars", []))      # per-step outputs
+    param_names = list(ctx.op.inputs.get("Params", []))
+    reverse = bool(attrs.get("reverse", False))
+
+    xs = ins.get("Inputs", [])
+    init_states = ins.get("InitStates", [])
+    params = ins.get("Params", [])
+    base_env = dict(zip(param_names, params))
+
+    if reverse:
+        xs = [jnp.flip(x, axis=0) for x in xs]
+
+    def step(states, xt):
+        xs_t, t = xt
+        env = dict(base_env)
+        env.update(zip(input_vars, xs_t))
+        env.update(zip(ex_state_vars, states))
+        # per-step RNG stream (dropout inside the cell)
+        sub_ctx = _StepCtx(ctx, t)
+        _run_sub_block(sub_ctx, sub, env)
+        new_states = tuple(env[n] for n in state_vars)
+        outs = tuple(env[n] for n in output_vars)
+        return new_states, outs
+
+    T = xs[0].shape[0] if xs else int(attrs.get("max_len", 1))
+    final_states, stacked = lax.scan(
+        step, tuple(init_states), (tuple(xs), jnp.arange(T))
+    )
+    stacked = [
+        jnp.flip(o, axis=0) if reverse else o for o in stacked
+    ]
+    return {"Outputs": list(stacked), "FinalStates": list(final_states)}
+
+
+class _StepCtx:
+    """LowerContext proxy whose rng key is folded with the scan step."""
+
+    def __init__(self, ctx, t):
+        object.__setattr__(self, "_base", ctx)
+        object.__setattr__(self, "_t", t)
+
+    def __getattr__(self, name):
+        if name == "_rng_key":
+            base = self._base._rng_key
+            if base is None:
+                return None
+            return jax.random.fold_in(base, self._t)
+        return getattr(self._base, name)
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray — fixed-capacity stacked buffer + live length
+# (reference: operators/controlflow/tensor_array_read_write_op.cc,
+# framework/lod_tensor_array.h). Value = {"buf": [cap, ...], "len": i32}.
+# ---------------------------------------------------------------------------
+
+DEFAULT_ARRAY_CAPACITY = 256
+
+
+@register_no_grad_op("create_array")
+def create_array_op(ctx, ins, attrs):
+    # Length-only sentinel; the first write materializes the buffer (needs
+    # the element shape, unknown until then).
+    return {"Out": [{"len": jnp.int32(0)}]}
+
+
+@register_no_grad_op("write_to_array")
+def write_to_array(ctx, ins, attrs):
+    x = single(ins, "X")
+    i = jnp.reshape(single(ins, "I"), ()).astype(jnp.int32)
+    arr = ins.get("Array", [None])
+    arr = arr[0] if arr else None
+    cap = int(attrs.get("capacity", DEFAULT_ARRAY_CAPACITY))
+    if arr is None or "buf" not in arr:
+        buf = jnp.zeros((cap,) + tuple(x.shape), x.dtype)
+        length = jnp.int32(0)
+    else:
+        buf = arr["buf"]
+        length = arr["len"]
+    buf = lax.dynamic_update_index_in_dim(buf, x, i, 0)
+    return {"Out": [{"buf": buf, "len": jnp.maximum(length, i + 1)}]}
+
+
+@register_no_grad_op("read_from_array")
+def read_from_array(ctx, ins, attrs):
+    arr = single(ins, "X")
+    i = jnp.reshape(single(ins, "I"), ()).astype(jnp.int32)
+    return {"Out": [lax.dynamic_index_in_dim(arr["buf"], i, 0,
+                                             keepdims=False)]}
+
+
+@register_no_grad_op("lod_array_length")
+def lod_array_length(ctx, ins, attrs):
+    arr = single(ins, "X")
+    return {"Out": [jnp.reshape(arr["len"], (1,)).astype(jnp.int64)]}

@@ -312,7 +312,9 @@ def pad2d(ctx, ins, attrs):
 @register_no_grad_op("increment")
 def increment(ctx, ins, attrs):
     x = single(ins, "X")
-    return {"Out": [x + attrs.get("step", 1.0)]}
+    # keep the input dtype (a float python step must not promote int
+    # counters — they are while-loop carries with fixed types)
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), dtype=x.dtype)]}
 
 
 @register_no_grad_op("assign_value")

@@ -1,0 +1,187 @@
+"""Control-flow tests: While→lax.while_loop, StaticRNN→lax.scan (with BPTT),
+Switch→conditional_block, tensor arrays.
+
+Mirrors the reference's test_while_op.py / test_recurrent_op.py /
+test_switch.py (python/paddle/fluid/tests/unittests/)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def test_while_counting_loop():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=10)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.While(cond=cond)
+        with w.block():
+            acc2 = fluid.layers.scale(acc, scale=1.0)
+            acc2 = fluid.layers.elementwise_add(
+                acc2, fluid.layers.cast(i, "float32"))
+            fluid.layers.assign(acc2, output=acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        a, iv = exe.run(main, feed={}, fetch_list=[acc, i])
+    assert float(a[0]) == sum(range(10))
+    assert int(iv[0]) == 10
+
+
+def test_while_with_array_write():
+    """Decode-style loop: write i^2 into a tensor array each iteration."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=5)
+        arr = fluid.layers.create_array(dtype="float32", capacity=8)
+        # materialize the buffer before the loop (iteration-0 write)
+        zero = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        fluid.layers.array_write(zero, i, array=arr)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.While(cond=cond)
+        with w.block():
+            sq = fluid.layers.cast(i, "float32")
+            sq = fluid.layers.elementwise_mul(sq, sq)
+            fluid.layers.array_write(sq, i, array=arr)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        ln = fluid.layers.array_length(arr)
+        last = fluid.layers.array_read(
+            arr, fluid.layers.fill_constant(shape=[1], dtype="int64", value=4))
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        n, lv = exe.run(main, feed={}, fetch_list=[ln, last])
+    assert int(n[0]) == 5
+    assert float(lv[0]) == 16.0
+
+
+def _numpy_simple_rnn(x, w, u, h0):
+    # h_t = tanh(x_t @ W + h_{t-1} @ U)
+    T = x.shape[0]
+    h = h0
+    outs = []
+    for t in range(T):
+        h = np.tanh(x[t] @ w + h @ u)
+        outs.append(h)
+    return np.stack(outs), h
+
+
+def test_static_rnn_forward_matches_numpy():
+    T, B, D, H = 4, 3, 5, 6
+    rng = np.random.RandomState(0)
+    xv = rng.randn(T, B, D).astype(np.float32)
+    h0v = rng.randn(B, H).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[B, D], dtype="float32")
+        # data() prepends a batch dim; treat dim0 as time
+        h0 = fluid.layers.data(name="h0", shape=[H], dtype="float32")
+        rnn = fluid.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            hprev = rnn.memory(init=h0)
+            xw = fluid.layers.fc(input=xt, size=H, bias_attr=False,
+                                 param_attr=fluid.ParamAttr(name="W"))
+            hu = fluid.layers.fc(input=hprev, size=H, bias_attr=False,
+                                 param_attr=fluid.ParamAttr(name="U"))
+            h = fluid.layers.tanh(fluid.layers.elementwise_add(xw, hu))
+            rnn.update_memory(hprev, h)
+            rnn.step_output(h)
+        out = rnn()
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wv = np.asarray(scope.get("W"))
+        uv = np.asarray(scope.get("U"))
+        (got,) = exe.run(main, feed={"x": xv, "h0": h0v}, fetch_list=[out])
+    want, _ = _numpy_simple_rnn(xv, wv, uv, h0v)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_static_rnn_trains():
+    """BPTT through the scan: loss on the final output must decrease."""
+    T, B, D, H = 6, 8, 4, 8
+    rng = np.random.RandomState(1)
+    xv = rng.randn(T, B, D).astype(np.float32)
+    yv = rng.randn(B, H).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[B, D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[H], dtype="float32")
+        h0 = fluid.layers.fill_constant(shape=[B, H], dtype="float32",
+                                        value=0.0)
+        rnn = fluid.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            hprev = rnn.memory(init=h0)
+            xw = fluid.layers.fc(input=xt, size=H, bias_attr=False)
+            hu = fluid.layers.fc(input=hprev, size=H, bias_attr=False)
+            h = fluid.layers.tanh(fluid.layers.elementwise_add(xw, hu))
+            rnn.update_memory(hprev, h)
+            rnn.step_output(h)
+        out = rnn()
+        last = fluid.layers.slice(out, axes=[0], starts=[T - 1], ends=[T])
+        last = fluid.layers.reshape(last, shape=[B, H])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=last, label=y))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (l,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_switch_piecewise():
+    """Switch cascade writing a pre-initialized var (LR-schedule pattern)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        step = fluid.layers.data(name="step", shape=[1], dtype="float32",
+                                 append_batch_size=False)
+        lr = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                        value=0.001)
+        b1 = fluid.layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+        b2 = fluid.layers.fill_constant(shape=[1], dtype="float32", value=20.0)
+        sw = fluid.Switch()
+        with sw.case(fluid.layers.less_than(x=step, y=b1)):
+            fluid.layers.assign(
+                fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=1.0), output=lr)
+        with sw.case(fluid.layers.less_than(x=step, y=b2)):
+            fluid.layers.assign(
+                fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=0.1), output=lr)
+        with sw.default():
+            fluid.layers.assign(
+                fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=0.01), output=lr)
+
+    exe = fluid.Executor()
+    for sv, expect in [(5.0, 1.0), (15.0, 0.1), (25.0, 0.01)]:
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (got,) = exe.run(
+                main, feed={"step": np.array([sv], np.float32)},
+                fetch_list=[lr])
+        assert abs(float(got[0]) - expect) < 1e-7, (sv, got)
