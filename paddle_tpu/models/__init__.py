@@ -1,0 +1,20 @@
+"""Model zoo: the reference's benchmark + book model families, built on the
+``paddle_tpu.fluid`` layer API (reference: benchmark/fluid/models/
+{mnist,resnet,vgg,se_resnext,stacked_dynamic_lstm,machine_translation}.py and
+python/paddle/fluid/tests/book/).
+
+Every builder returns ``(feeds, loss, extras)``-style handles so the same
+model drops into Executor.run, CompiledProgram.with_data_parallel, or the
+bench harness.
+"""
+
+from paddle_tpu.models import mnist  # noqa: F401
+from paddle_tpu.models import resnet  # noqa: F401
+from paddle_tpu.models import vgg  # noqa: F401
+from paddle_tpu.models import se_resnext  # noqa: F401
+from paddle_tpu.models import mobilenet  # noqa: F401
+from paddle_tpu.models import lstm  # noqa: F401
+from paddle_tpu.models import transformer  # noqa: F401
+from paddle_tpu.models import bert  # noqa: F401
+from paddle_tpu.models import deepfm  # noqa: F401
+from paddle_tpu.models import word2vec  # noqa: F401

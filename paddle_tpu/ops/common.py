@@ -9,6 +9,9 @@ def bcast_y_to_x(x, y, axis):
     the trim-trailing-ones + mid-broadcast rule)."""
     if x.shape == y.shape:
         return y
+    if y.ndim > x.ndim:
+        # e.g. scalar X vs [1] Y — plain numpy broadcasting is well-defined
+        return y
     if axis == -1:
         axis = x.ndim - y.ndim
     # Trim trailing 1s of y (reference does this before computing n/post)

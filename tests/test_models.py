@@ -1,0 +1,173 @@
+"""Model-zoo smoke + convergence tests at tiny scale (the analog of the
+reference's book/ and parallel-executor model tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import models
+
+
+def _train(main, startup, feed_fn, loss_var, steps=15):
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(steps):
+            (l,) = exe.run(main, feed=feed_fn(i), fetch_list=[loss_var])
+            losses.append(float(l))
+    return losses
+
+
+def test_mnist_mlp_converges():
+    main, startup, h = models.mnist.get_model(lr=0.01)
+    rng = np.random.RandomState(0)
+    W = rng.randn(784, 10).astype(np.float32)
+
+    batches = []
+    for _ in range(4):
+        x = rng.randn(64, 784).astype(np.float32)
+        y = np.argmax(x @ W, 1).astype(np.int64).reshape(-1, 1)
+        batches.append({"img": x, "label": y})
+
+    losses = _train(main, startup, lambda i: batches[i % 4], h["loss"],
+                    steps=60)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_mnist_conv_runs():
+    main, startup, h = models.mnist.get_model(use_conv=True)
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        return {
+            "img": rng.randn(8, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (8, 1)).astype(np.int64),
+        }
+
+    losses = _train(main, startup, feed, h["loss"], steps=3)
+    assert np.isfinite(losses).all()
+
+
+def test_resnet_cifar_trains():
+    main, startup, h = models.resnet.get_model(dataset="cifar10", depth=8,
+                                               lr=0.1)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (8, 1)).astype(np.int64)
+
+    losses = _train(main, startup, lambda i: {"img": x, "label": y},
+                    h["loss"], steps=15)
+    assert losses[-1] < losses[0], losses  # memorizing one batch
+
+
+def test_resnet50_imagenet_builds_and_steps():
+    main, startup, h = models.resnet.get_model(dataset="imagenet", depth=50,
+                                               class_num=100)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 224, 224).astype(np.float32)
+    y = rng.randint(0, 100, (2, 1)).astype(np.int64)
+    losses = _train(main, startup, lambda i: {"img": x, "label": y},
+                    h["loss"], steps=2)
+    assert np.isfinite(losses).all()
+
+
+def test_vgg_trains():
+    main, startup, h = models.vgg.get_model(class_num=10, lr=0.002)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (8, 1)).astype(np.int64)
+    losses = _train(main, startup, lambda i: {"img": x, "label": y},
+                    h["loss"], steps=6)
+    assert np.isfinite(losses).all()
+
+
+def test_se_resnext_small_trains():
+    main, startup, h = models.se_resnext.get_model(
+        class_num=10, image_shape=(3, 16, 16), small=True, lr=0.05)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3, 16, 16).astype(np.float32)
+    y = rng.randint(0, 10, (8, 1)).astype(np.int64)
+    losses = _train(main, startup, lambda i: {"img": x, "label": y},
+                    h["loss"], steps=10)
+    assert losses[-1] < losses[0], losses
+
+
+def test_mobilenet_builds_and_steps():
+    main, startup, h = models.mobilenet.get_model(
+        class_num=10, image_shape=(3, 64, 64), scale=0.25)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3, 64, 64).astype(np.float32)
+    y = rng.randint(0, 10, (4, 1)).astype(np.int64)
+    losses = _train(main, startup, lambda i: {"img": x, "label": y},
+                    h["loss"], steps=2)
+    assert np.isfinite(losses).all()
+
+
+def test_stacked_lstm_trains():
+    main, startup, h = models.lstm.get_model(
+        seq_len=12, dict_dim=100, emb_dim=16, hidden_dim=16, lr=0.05)
+    rng = np.random.RandomState(0)
+    seq = rng.randint(0, 100, (16, 12)).astype(np.int64)
+    # label: parity of first token — learnable from embedding
+    y = (seq[:, 0] % 2).astype(np.int64).reshape(-1, 1)
+    losses = _train(main, startup, lambda i: {"seq": seq, "label": y},
+                    h["loss"], steps=30)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_transformer_copy_task_trains():
+    B, T, V, H = 8, 10, 50, 4
+    main, startup, h = models.transformer.get_model(
+        batch_size=B, seq_len=T, vocab_size=V, d_model=32, n_heads=H,
+        d_inner=64, n_layers=2, dropout=0.0, lr=3e-3, label_smooth_eps=0.0)
+    batch = models.transformer.make_fake_batch(B, T, V, H)
+    losses = _train(main, startup, lambda i: batch, h["loss"], steps=30)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_bert_tiny_trains():
+    B, T, V, Hn = 4, 16, 100, 2
+    main, startup, h = models.bert.get_model(
+        batch_size=B, seq_len=T, vocab_size=V, d_model=32, n_layers=2,
+        n_heads=Hn, d_inner=64, dropout=0.0, lr=2e-3, max_position=T)
+    batch = models.bert.make_fake_batch(B, T, V, Hn)
+    losses = _train(main, startup, lambda i: batch, h["loss"], steps=25)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_deepfm_trains():
+    main, startup, h = models.deepfm.get_model(
+        num_features=500, num_fields=5, embed_dim=4, lr=0.05)
+    batch = models.deepfm.make_fake_batch(64, 500, 5)
+    losses = _train(main, startup, lambda i: batch, h["loss"], steps=30)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_word2vec_trains():
+    main, startup, h = models.word2vec.get_model(
+        dict_size=50, embed_dim=16, hidden_size=32, window=4, lr=0.5)
+    batch = models.word2vec.make_fake_batch(64, 50, 4)
+    losses = _train(main, startup, lambda i: batch, h["loss"], steps=150)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_resnet_test_clone_inference():
+    """for_test clone of a BN model must run without labels and be
+    deterministic."""
+    main, startup, h = models.resnet.get_model(dataset="cifar10", depth=8)
+    test_prog = main.clone(for_test=True)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (4, 1)).astype(np.int64)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"img": x, "label": y}, fetch_list=[h["loss"]])
+        (p1,) = exe.run(test_prog, feed={"img": x},
+                        fetch_list=[h["logits"]])
+        (p2,) = exe.run(test_prog, feed={"img": x},
+                        fetch_list=[h["logits"]])
+    assert np.array_equal(p1, p2)
