@@ -62,6 +62,8 @@ class CompiledProgram:
         self._exec_strategy = None
         self._share_vars_from = None
         self._mesh = None
+        self._shard_rules = None
+        self._data_axes = ("dp",)
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -72,6 +74,29 @@ class CompiledProgram:
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._share_vars_from = share_vars_from
         self._places = places
+        return self
+
+    def with_spmd(self, mesh=None, mesh_axes=None, shard_rules=None,
+                  data_axes=("dp",), loss_name=None):
+        """General SPMD strategy: arbitrary mesh (dp/tp/sp/pp/ep axes) plus
+        name-pattern → PartitionSpec rules for parameters/optimizer state.
+        ``with_data_parallel`` is the special case of a 1-axis dp mesh with
+        no rules. See paddle_tpu.parallel (ShardingRules, make_mesh)."""
+        from paddle_tpu.parallel.mesh import make_mesh
+        from paddle_tpu.parallel.sharding import ShardingRules
+
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if mesh is None:
+            if mesh_axes is None:
+                raise ValueError("with_spmd needs mesh or mesh_axes")
+            mesh = make_mesh(mesh_axes)
+        self._mesh = mesh
+        if shard_rules is not None and not isinstance(shard_rules,
+                                                      ShardingRules):
+            shard_rules = ShardingRules(shard_rules)
+        self._shard_rules = shard_rules
+        self._data_axes = tuple(data_axes)
         return self
 
     # -- internals ---------------------------------------------------------
@@ -103,6 +128,11 @@ class CompiledProgram:
             is_test=getattr(self._program, "_is_test", False),
             return_numpy=return_numpy,
             seed=getattr(self._program, "random_seed", 0) or 0,
-            cache_key_extra=("dp", len(mesh.devices)),
+            cache_key_extra=(
+                "spmd", tuple(mesh.shape.items()), id(self._shard_rules),
+                self._data_axes,
+            ),
             mesh=mesh,
+            shard_rules=self._shard_rules,
+            data_axes=self._data_axes,
         )

@@ -50,6 +50,8 @@ class Engine:
         seed=0,
         donate_state=True,
         mesh=None,
+        shard_rules=None,
+        data_axes=("dp",),
     ):
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -81,6 +83,7 @@ class Engine:
             compiled = self._compile(
                 block, feed_names, fetch_list, is_test, donate_state,
                 mesh=mesh, feed_values=feed_values,
+                shard_rules=shard_rules, data_axes=data_axes,
             )
             self._cache[key] = compiled
 
@@ -112,7 +115,8 @@ class Engine:
 
     # -- internals ---------------------------------------------------------
     def _compile(self, block, feed_names, fetch_list, is_test, donate_state,
-                 mesh=None, feed_values=None):
+                 mesh=None, feed_values=None, shard_rules=None,
+                 data_axes=("dp",)):
         bp = BlockProgram(block, feed_names, fetch_list, ())
         fn = lower_block(bp, is_test=is_test, executor=self)
 
@@ -134,30 +138,47 @@ class Engine:
         donate = (1,) if (donate_state and mutated) else ()
         jit_kwargs = {}
         if mesh is not None:
-            # SPMD data parallelism: batch-shard the feeds over the 'dp'
-            # mesh axis, replicate state; XLA inserts the gradient
-            # all-reduce collectives over ICI (replaces the reference's
-            # details/all_reduce_op_handle.cc NCCL calls).
+            # SPMD: batch-shard the feeds over the data axes and lay out
+            # state per the declared sharding rules (replicated when no rule
+            # matches); XLA's partitioner derives every collective —
+            # all-reduce for replicated params, reduce-scatter for sharded —
+            # compiled onto ICI (replaces the reference's
+            # details/all_reduce_op_handle.cc NCCL calls and the whole
+            # multi_devices_graph_pass mode zoo).
             from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.parallel.sharding import batch_sharding
 
-            ndev = mesh.devices.size
             rep = NamedSharding(mesh, P())
 
-            def feed_sharding(v):
-                if v.ndim >= 1 and v.shape[0] % ndev == 0 and v.shape[0] > 0:
-                    return NamedSharding(mesh, P("dp"))
-                return rep
+            def state_sharding(name):
+                if shard_rules is None:
+                    return rep
+                spec = shard_rules.spec_for(name)
+                if not len(spec):
+                    return rep
+                vd = block.find_var_recursive(name)
+                ndim = (len(vd.shape) if vd is not None
+                        and vd.shape is not None else None)
+                # a rule matching a lower-rank var (e.g. an optimizer's
+                # scalar beta-pow accumulator named after the param) falls
+                # back to replicated
+                if ndim is None or len(spec) > ndim:
+                    return rep
+                return NamedSharding(mesh, spec)
 
-            feed_sh = [feed_sharding(v) for v in (feed_values or [])]
+            feed_sh = [
+                batch_sharding(mesh, v, data_axes)
+                for v in (feed_values or [])
+            ]
             jit_kwargs["in_shardings"] = (
                 feed_sh,
-                [rep] * len(mutated),
-                [rep] * len(readonly),
+                [state_sharding(n) for n in mutated],
+                [state_sharding(n) for n in readonly],
                 rep,
             )
             jit_kwargs["out_shardings"] = (
                 [rep] * len(bp.fetch_names),
-                [rep] * len(bp.state_out_names),
+                [state_sharding(n) for n in bp.state_out_names],
             )
         jitted = jax.jit(wrapped, donate_argnums=donate, **jit_kwargs)
         return CompiledBlock(bp, jitted, mutated, readonly)

@@ -1,0 +1,33 @@
+"""Parallelism package: device meshes, SPMD sharding rules, and
+sequence-parallel (ring) attention.
+
+This is the TPU-native replacement for the reference's entire multi-device
+stack (reference: paddle/fluid/framework/details/ SSA-graph scheduler +
+NCCL op handles, and transpiler/distribute_transpiler.py) — instead of a
+host-side ready-queue cloning ops per device and inserting per-grad
+ncclAllReduce handles (multi_devices_graph_pass.cc:515-522), one program is
+jitted under a ``jax.sharding.Mesh`` with sharding annotations; XLA's SPMD
+partitioner inserts all collectives, compiled onto ICI.
+
+Axes follow the scaling-book convention: ``dp`` (batch), ``tp`` (feature/
+model), ``sp`` (sequence/context), ``pp`` (pipeline stage), ``ep``
+(expert/embedding shard).
+"""
+
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    get_default_mesh,
+    set_default_mesh,
+)
+from paddle_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    batch_sharding,
+)
+from paddle_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    reference_attention,
+)
+from paddle_tpu.parallel.env import (  # noqa: F401
+    init_distributed,
+    get_world_info,
+)

@@ -1,0 +1,45 @@
+"""Multi-host bootstrap (replaces the reference's gen_nccl_id rendezvous:
+rank 0 creates an ncclUniqueId and gRPC-broadcasts it,
+operators/distributed_ops/gen_nccl_id_op.cc + nccl_helper.h:129 — on TPU
+the PJRT distributed runtime's coordinator + KV store plays that role via
+``jax.distributed``)."""
+
+import os
+
+
+def get_world_info():
+    """Rank/world-size from the launcher env (same variables the reference's
+    launcher sets, python/paddle/distributed/launch.py:24-53)."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+    world = int(os.environ.get(
+        "PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+    endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    ends = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return {
+        "rank": rank,
+        "world_size": world,
+        "endpoint": endpoint,
+        "endpoints": [e for e in ends.split(",") if e],
+    }
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Initialize the cross-host coordinator. Safe no-op for 1 process."""
+    info = get_world_info()
+    num_processes = num_processes or info["world_size"]
+    process_id = process_id if process_id is not None else info["rank"]
+    if num_processes <= 1:
+        return info
+    if coordinator_address is None:
+        eps = info["endpoints"]
+        coordinator_address = eps[0] if eps else "127.0.0.1:12355"
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return {**info, "world_size": num_processes, "rank": process_id}

@@ -1,0 +1,41 @@
+"""Device-mesh helpers (replaces the reference's device-list plumbing:
+places vector in parallel_executor.cc:205-217 and NCCLContextMap
+nccl_helper.h:86 — on TPU the mesh IS the communicator)."""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_default_mesh = None
+
+
+def make_mesh(axes, devices=None):
+    """``make_mesh({'dp': 2, 'tp': 4}) -> Mesh`` over the first dp*tp
+    devices, ordered so the innermost axis maps to adjacent devices (ICI
+    neighbors on a real slice)."""
+    if not axes:
+        raise ValueError("axes must be a non-empty {name: size} dict")
+    names = list(axes.keys())
+    sizes = [int(axes[n]) for n in names]
+    n_needed = int(np.prod(sizes))
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_needed:
+        raise ValueError(
+            "mesh %r needs %d devices, have %d" % (axes, n_needed,
+                                                   len(devices)))
+    dev_array = np.array(devices[:n_needed]).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def set_default_mesh(mesh):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh():
+    """The ambient mesh: the one set via ``set_default_mesh`` or a 1-D
+    'dp' mesh over all devices."""
+    if _default_mesh is not None:
+        return _default_mesh
+    return make_mesh({"dp": len(jax.devices())})

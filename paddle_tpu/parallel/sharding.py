@@ -1,0 +1,62 @@
+"""Sharding rules: name-pattern → PartitionSpec mapping applied to program
+state when a block is jitted over a mesh.
+
+This replaces the reference's per-mode multi-device graph builders
+(reference: details/multi_devices_graph_pass.cc AllReduce/Reduce/Dist
+builders): instead of choosing how to place each gradient, you declare how
+each PARAMETER is laid out; XLA's partitioner derives every gradient
+collective (all-reduce for replicated, reduce-scatter for sharded) from the
+layout — the scaling-book recipe."""
+
+import re
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins.
+
+    >>> rules = ShardingRules([
+    ...     (r".*fc_0\\.w.*", PartitionSpec(None, "tp")),   # column-parallel
+    ...     (r".*fc_1\\.w.*", PartitionSpec("tp", None)),   # row-parallel
+    ... ])
+    Unmatched state is replicated.
+    """
+
+    def __init__(self, rules=()):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def add(self, pattern, spec):
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name, ndim=None):
+        for pat, spec in self._rules:
+            if pat.search(name):
+                if ndim is not None and len(spec) > ndim:
+                    raise ValueError(
+                        "sharding rule %r has rank %d > var %r rank %d"
+                        % (pat.pattern, len(spec), name, ndim))
+                return spec
+        return PartitionSpec()
+
+    def sharding_for(self, mesh, name, value=None):
+        ndim = getattr(value, "ndim", None)
+        return NamedSharding(mesh, self.spec_for(name, ndim))
+
+
+def batch_sharding(mesh, value, data_axes=("dp",)):
+    """Shard the leading (batch) dim over the data axes if divisible,
+    else replicate (ragged last batches fall back gracefully — the analog
+    of the reference's DataBalanceOpHandle)."""
+    axes = [a for a in data_axes if a in mesh.axis_names]
+    if not axes:
+        return NamedSharding(mesh, PartitionSpec())
+    total = 1
+    for a in axes:
+        total *= mesh.shape[a]
+    if getattr(value, "ndim", 0) >= 1 and value.shape[0] % total == 0 \
+            and value.shape[0] > 0:
+        return NamedSharding(
+            mesh, PartitionSpec(tuple(axes) if len(axes) > 1 else axes[0]))
+    return NamedSharding(mesh, PartitionSpec())
