@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the framework's answer to the reference's
+hand-written CUDA/JIT kernel layer (reference: paddle/fluid/operators/*.cu +
+operators/jit/ xbyak codegen): XLA fuses the bulk; these kernels cover the
+patterns worth hand-scheduling (flash attention today; quantized matmul and
+ragged ops next)."""
+
+from paddle_tpu.kernels.flash_attention import (  # noqa: F401
+    flash_attention,
+    fused_attention,
+)

@@ -1,0 +1,159 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Streams K/V blocks from VMEM against a resident Q block with online-softmax
+accumulation — O(T) memory, MXU-shaped contractions (the kernel the
+reference implements as math/softmax.cu + matmuls, fused here instead).
+
+``fused_attention`` is the dispatch point: the Pallas kernel on TPU (or in
+interpreter mode for tests), the plain-XLA composition elsewhere. The
+backward pass recomputes attention in XLA (flash-style backward kernel is a
+follow-up; recompute keeps training memory at O(T) like jax.checkpoint
+would).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_NEG = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                 block_q):
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    j = pl.program_id(1)
+    T = k_ref.shape[1]
+    nk = T // block_k
+
+    q_pos = j * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(s, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+        sij = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = s * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+        m_new = jnp.maximum(m, jnp.max(sij, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(sij - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+
+    if causal:
+        # blocks fully above the diagonal contribute nothing — skip them
+        nk_eff = jnp.minimum(
+            nk, (j + 1) * block_q // block_k + (1 if block_q % block_k else 0)
+        )
+        nk_eff = jnp.maximum(nk_eff, 1)
+    else:
+        nk_eff = nk
+    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, T, D)
+    vr = v.reshape(B * H, T, D)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    grid = (B * H, T // block_q)
+
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, causal=causal, scale=scale,
+        block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, T, D)
+
+
+def _xla_attention(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """[B, H, T, D] attention via the Pallas kernel; T must divide by the
+    block sizes (clamped to T)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, scale_),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def fused_attention(q, k, v, causal=False, scale=None, force_pallas=None):
+    """Pallas flash attention on TPU; plain-XLA composition elsewhere.
+    ``force_pallas=True`` runs the kernel in interpreter mode off-TPU
+    (tests)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    T = q.shape[2]
+    use_pallas = force_pallas if force_pallas is not None else (
+        _HAS_PLTPU and _on_tpu() and T % 128 == 0)
+    if use_pallas:
+        return flash_attention(q, k, v, causal, scale,
+                               interpret=not _on_tpu())
+    return _xla_attention(q, k, v, causal, scale)

@@ -202,6 +202,21 @@ def batch_norm(ctx, ins, attrs):
     }
 
 
+@register_op("fused_attention")
+def fused_attention_op(ctx, ins, attrs):
+    """Whole-attention fusion: Pallas flash kernel on TPU, XLA composition
+    elsewhere (inputs Q/K/V are [B, H, T, D])."""
+    from paddle_tpu.kernels import fused_attention as _fa
+
+    q = single(ins, "Q")
+    k = single(ins, "K")
+    v = single(ins, "V")
+    out = _fa(q, k, v,
+              causal=bool(attrs.get("causal", False)),
+              scale=attrs.get("scale", None))
+    return {"Out": [out]}
+
+
 @register_op("layer_norm")
 def layer_norm(ctx, ins, attrs):
     x = single(ins, "X")

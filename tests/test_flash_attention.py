@@ -1,0 +1,75 @@
+"""Flash-attention Pallas kernel tests, run in interpreter mode on the CPU
+backend (the compiled path differs only in lowering, not math)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import flash_attention
+from paddle_tpu.kernels.flash_attention import _xla_attention
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class TestFlashAttentionKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("T,block", [(128, 128), (256, 128), (64, 32)])
+    def test_forward_matches_xla(self, causal, T, block):
+        B, H, D = 2, 2, 32
+        q, k, v = (_rand((B, H, T, D), s) for s in (0, 1, 2))
+        got = flash_attention(q, k, v, causal, None, block, block, True)
+        want = _xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal, D ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_gradients(self):
+        B, H, T, D = 1, 2, 64, 16
+        q, k, v = (_rand((B, H, T, D), s) for s in (3, 4, 5))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, None, 32, 32, True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_xla_attention(q, k, v, True, D ** -0.5) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-3)
+
+
+class TestFusedAttentionOp:
+    def test_program_op(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.framework import Program, program_guard
+        from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+        B, H, T, D = 2, 2, 16, 8
+        q, k, v = (_rand((B, H, T, D), s) for s in (6, 7, 8))
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            block = main.global_block()
+            for n, arr in (("q", q), ("k", k), ("v", v)):
+                block.create_var(name=n, shape=list(arr.shape),
+                                 dtype=convert_np_dtype_to_dtype_(arr.dtype))
+            block.create_var(name="out", shape=None, dtype="float32")
+            block.append_op(
+                type="fused_attention",
+                inputs={"Q": ["q"], "K": ["k"], "V": ["v"]},
+                outputs={"Out": ["out"]},
+                attrs={"causal": True},
+            )
+            exe = fluid.Executor()
+            (got,) = exe.run(main, feed={"q": q, "k": k, "v": v},
+                             fetch_list=["out"])
+        want = _xla_attention(jnp.asarray(q), jnp.asarray(k),
+                              jnp.asarray(v), True, D ** -0.5)
+        np.testing.assert_allclose(got, np.asarray(want), atol=2e-5,
+                                   rtol=2e-4)
