@@ -1,0 +1,10 @@
+"""Dataset loaders (reference: python/paddle/dataset/ — mnist.py, cifar.py,
+imdb.py, uci_housing.py). The image has zero egress, so loaders read from a
+local data directory when present and otherwise serve deterministic
+synthetic data with the real shapes/vocabularies — enough for the training
+pipeline, tests, and benchmarks to run unmodified."""
+
+from paddle_tpu.dataset import mnist  # noqa: F401
+from paddle_tpu.dataset import cifar  # noqa: F401
+from paddle_tpu.dataset import imdb  # noqa: F401
+from paddle_tpu.dataset import uci_housing  # noqa: F401

@@ -1,0 +1,63 @@
+"""MNIST reader (reference: python/paddle/dataset/mnist.py — yields
+(784-float image in [-1,1], int label)). Reads IDX files from
+$PADDLE_TPU_DATA/mnist when present, else synthesizes a deterministic
+pseudo-MNIST with class-dependent structure."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+_DATA_DIR = os.environ.get("PADDLE_TPU_DATA", "")
+
+
+def _idx_paths(split):
+    base = os.path.join(_DATA_DIR, "mnist")
+    if split == "train":
+        return (os.path.join(base, "train-images-idx3-ubyte.gz"),
+                os.path.join(base, "train-labels-idx1-ubyte.gz"))
+    return (os.path.join(base, "t10k-images-idx3-ubyte.gz"),
+            os.path.join(base, "t10k-labels-idx1-ubyte.gz"))
+
+
+def _read_idx(images_path, labels_path):
+    with gzip.open(labels_path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(n), dtype=np.uint8)
+    with gzip.open(images_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        images = images.reshape(n, rows * cols)
+    return images, labels
+
+
+def _synthetic(n, seed):
+    """Class-structured fake digits: label-specific template + noise."""
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(10, 784).astype(np.float32)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    images = templates[labels] + 0.5 * rng.randn(n, 784).astype(np.float32)
+    images = np.clip((images + 3) / 6 * 255, 0, 255).astype(np.uint8)
+    return images, labels
+
+
+def _reader(split, n_synth, seed):
+    def reader():
+        imgs_path, lbls_path = _idx_paths(split)
+        if os.path.exists(imgs_path) and os.path.exists(lbls_path):
+            images, labels = _read_idx(imgs_path, lbls_path)
+        else:
+            images, labels = _synthetic(n_synth, seed)
+        for img, lbl in zip(images, labels):
+            yield (img.astype(np.float32) / 127.5 - 1.0), int(lbl)
+
+    return reader
+
+
+def train():
+    return _reader("train", 2048, 0)
+
+
+def test():
+    return _reader("test", 512, 1)

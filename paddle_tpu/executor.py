@@ -13,6 +13,11 @@ from paddle_tpu.platform import CPUPlace, default_accelerator_place
 _global_scope = Scope()
 
 
+class EOFException(Exception):
+    """Raised when a PyReader-fed program exhausts its epoch
+    (reference: fluid.core.EOFException from the C++ reader ops)."""
+
+
 def global_scope():
     return _global_scope
 
@@ -64,6 +69,17 @@ class Executor:
 
         if program is None:
             program = default_main_program()
+
+        if feed is None and getattr(program, "_py_readers", None):
+            # decoupled feeding: pull the next prefetched batch
+            feed = {}
+            for rdr in program._py_readers:
+                nxt = rdr.next_feed()
+                if nxt is None:
+                    raise EOFException(
+                        "py_reader epoch exhausted; call reader.start() "
+                        "for the next epoch")
+                feed.update(nxt)
 
         feed = _as_feed_dict(feed)
         fetch_names = [

@@ -49,6 +49,10 @@ from paddle_tpu.parallel_executor import (  # noqa: F401
     BuildStrategy,
 )
 from paddle_tpu import io  # noqa: F401
+from paddle_tpu import recordio  # noqa: F401
+from paddle_tpu import reader  # noqa: F401
+from paddle_tpu.executor import EOFException  # noqa: F401
+from paddle_tpu.layers.io import py_reader, PyReader  # noqa: F401
 from paddle_tpu.io import (  # noqa: F401
     save_params,
     save_persistables,

@@ -11,7 +11,12 @@ from paddle_tpu.layers.control_flow import (  # noqa: F401
     increment,
 )
 from paddle_tpu.layers.ops import *  # noqa: F401,F403
-from paddle_tpu.layers.io import data  # noqa: F401
+from paddle_tpu.layers.io import (  # noqa: F401
+    data,
+    py_reader,
+    double_buffer,
+    PyReader,
+)
 from paddle_tpu.layers.loss import *  # noqa: F401,F403
 from paddle_tpu.layers.metric_op import accuracy, auc  # noqa: F401
 from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401

@@ -1,7 +1,14 @@
-"""Data-entry layers (reference: python/paddle/fluid/layers/io.py — data:39)."""
+"""Data-entry layers (reference: python/paddle/fluid/layers/io.py — data:39,
+py_reader:636, double_buffer:1005)."""
+
+import pickle
+import threading
+
+import numpy as np
 
 from paddle_tpu.framework import default_main_program
-from paddle_tpu.core.types import VarType
+from paddle_tpu.core.types import VarType, convert_dtype_to_np
+from paddle_tpu.native import BlockingQueue
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -23,3 +30,90 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         stop_gradient=stop_gradient,
         type=type,
     )
+
+
+class PyReader:
+    """Decoupled feeding: a background thread decodes batches through the
+    native blocking queue; ``Executor.run`` with no explicit feed pops the
+    next batch for this program (reference: layers/io.py:636 py_reader over
+    LoDTensorBlockingQueue + double_buffer — prefetch overlaps device
+    execution)."""
+
+    def __init__(self, feed_vars, capacity):
+        self.vars = list(feed_vars)
+        self.var_names = [v.name for v in self.vars]
+        self._dtypes = [convert_dtype_to_np(v.dtype) for v in self.vars]
+        self._queue = BlockingQueue(capacity=capacity)
+        self._thread = None
+        self._reader = None
+        self._exhausted = False
+
+    def decorate_paddle_reader(self, reader):
+        """reader() yields per-batch tuples aligned with the declared
+        vars."""
+        self._reader = reader
+
+    decorate_batch_generator = decorate_paddle_reader
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def start(self):
+        assert self._reader is not None, "decorate a reader before start()"
+        self._queue.reset()
+        self._exhausted = False
+
+        def producer():
+            try:
+                for batch in self._reader():
+                    arrays = [
+                        np.asarray(x, dtype=dt)
+                        for x, dt in zip(batch, self._dtypes)
+                    ]
+                    payload = pickle.dumps(arrays, protocol=4)
+                    if not self._queue.push(payload):
+                        return
+            finally:
+                self._queue.close()
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+
+    def next_feed(self):
+        """dict name->array, or None when the epoch is exhausted."""
+        item = self._queue.pop()
+        if item is None:
+            self._exhausted = True
+            return None
+        arrays = pickle.loads(item)
+        return dict(zip(self.var_names, arrays))
+
+    def reset(self):
+        self._queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._queue.reset()
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Create feed vars + a PyReader pump registered on the program
+    (reference API: layers/io.py:636). Returns the PyReader; its ``.vars``
+    are the program inputs."""
+    from paddle_tpu import unique_name
+
+    program = default_main_program()
+    feed_vars = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        vname = unique_name.generate("%s_slot_%d" % (name or "py_reader", i))
+        feed_vars.append(data(
+            name=vname, shape=list(shape), dtype=dtype,
+            append_batch_size=False))
+    reader = PyReader(feed_vars, capacity)
+    if not hasattr(program, "_py_readers"):
+        program._py_readers = []
+    program._py_readers.append(reader)
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    """Kept for API parity — prefetch is inherent to PyReader's queue."""
+    return reader

@@ -1,0 +1,153 @@
+"""Native runtime components, built on first import with the system g++
+and bound through ctypes (the image has no pybind11; reference-parity
+components that are C++ in the reference stay C++ here — SURVEY.md §2.11).
+
+``lib()`` returns the loaded CDLL or None when no toolchain is available;
+callers fall back to pure-Python implementations in that case.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["recordio.cc", "blocking_queue.cc"]
+_SO_PATH = os.path.join(_DIR, "libpaddle_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _needs_build():
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > so_mtime for s in _SOURCES
+    )
+
+
+def _build():
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO_PATH,
+           *srcs, "-lpthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _bind(lib):
+    c = ctypes
+    lib.rio_writer_open.restype = c.c_void_p
+    lib.rio_writer_open.argtypes = [c.c_char_p, c.c_uint32, c.c_uint64]
+    lib.rio_writer_write.restype = c.c_int
+    lib.rio_writer_write.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.rio_writer_close.restype = c.c_int
+    lib.rio_writer_close.argtypes = [c.c_void_p]
+    lib.rio_reader_open.restype = c.c_void_p
+    lib.rio_reader_open.argtypes = [c.c_char_p]
+    lib.rio_reader_next.restype = c.c_int64
+    lib.rio_reader_next.argtypes = [c.c_void_p, c.POINTER(c.c_char_p)]
+    lib.rio_reader_close.argtypes = [c.c_void_p]
+
+    lib.btq_create.restype = c.c_void_p
+    lib.btq_create.argtypes = [c.c_uint64]
+    lib.btq_push.restype = c.c_int
+    lib.btq_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.btq_pop.restype = c.c_int64
+    lib.btq_pop.argtypes = [c.c_void_p, c.POINTER(c.POINTER(c.c_char))]
+    lib.btq_free_buf.argtypes = [c.POINTER(c.c_char)]
+    lib.btq_size.restype = c.c_uint64
+    lib.btq_size.argtypes = [c.c_void_p]
+    lib.btq_close.argtypes = [c.c_void_p]
+    lib.btq_reset.argtypes = [c.c_void_p]
+    lib.btq_destroy.argtypes = [c.c_void_p]
+    return lib
+
+
+def lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if _needs_build():
+                _build()
+            _lib = _bind(ctypes.CDLL(_SO_PATH))
+        except Exception:
+            _build_failed = True
+            _lib = None
+    return _lib
+
+
+class BlockingQueue:
+    """Bounded byte-buffer queue (native when available). The capacity
+    bound gives backpressure; ``close`` lets poppers drain then signals
+    end-of-stream — the LoDTensorBlockingQueue contract."""
+
+    def __init__(self, capacity=64):
+        self._native = lib()
+        if self._native is not None:
+            self._q = self._native.btq_create(capacity)
+        else:
+            import queue
+
+            self._q = queue.Queue(maxsize=capacity)
+            self._closed = False
+
+    def push(self, data: bytes) -> bool:
+        if self._native is not None:
+            return self._native.btq_push(self._q, data, len(data)) == 0
+        if self._closed:
+            return False
+        self._q.put(data)
+        return True
+
+    def pop(self):
+        """bytes, or None at end-of-stream."""
+        if self._native is not None:
+            out = ctypes.POINTER(ctypes.c_char)()
+            n = self._native.btq_pop(self._q, ctypes.byref(out))
+            if n < 0:
+                return None
+            data = ctypes.string_at(out, n)
+            self._native.btq_free_buf(out)
+            return data
+        import queue
+
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed:
+                    return None
+
+    def size(self):
+        if self._native is not None:
+            return int(self._native.btq_size(self._q))
+        return self._q.qsize()
+
+    def close(self):
+        if self._native is not None:
+            self._native.btq_close(self._q)
+        else:
+            self._closed = True
+
+    def reset(self):
+        if self._native is not None:
+            self._native.btq_reset(self._q)
+        else:
+            import queue
+
+            self._q = queue.Queue(maxsize=self._q.maxsize)
+            self._closed = False
+
+    def __del__(self):
+        try:
+            if getattr(self, "_native", None) is not None:
+                self._native.btq_destroy(self._q)
+        except Exception:
+            pass

@@ -1,0 +1,13 @@
+"""Reader composition (reference: python/paddle/reader/decorator.py)."""
+
+from paddle_tpu.reader.decorator import (  # noqa: F401
+    batch,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)

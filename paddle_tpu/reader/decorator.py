@@ -1,0 +1,174 @@
+"""Reader decorators: composable generators over samples
+(reference: python/paddle/reader/decorator.py — map_readers:42,
+shuffle:63, chain, compose, buffered:179, xmap_readers:236)."""
+
+import itertools
+import random
+import threading
+
+from paddle_tpu.native import BlockingQueue
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                yield sum(
+                    (make_tuple(o) for o in outputs if o is not None), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch through the native blocking queue."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        import pickle
+
+        q = BlockingQueue(capacity=size)
+
+        def producer():
+            try:
+                for e in reader():
+                    if not q.push(pickle.dumps(e, protocol=4)):
+                        return
+            finally:
+                q.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.pop()
+            if item is None:
+                break
+            yield pickle.loads(item)
+
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for e in reader():
+            b.append(e)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, e in enumerate(reader()):
+            if i >= n:
+                break
+            yield e
+
+    return firstn_reader
+
+
+def cache(reader):
+    all_data = []
+
+    def cache_reader():
+        if not all_data:
+            all_data.extend(reader())
+        for e in all_data:
+            yield e
+
+    return cache_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads feeding a bounded
+    queue (reference: decorator.py:236)."""
+    import pickle
+
+    def data_reader():
+        in_q = BlockingQueue(capacity=buffer_size)
+        out_q = BlockingQueue(capacity=buffer_size)
+        n_done = [0]
+        done_lock = threading.Lock()
+
+        def feed():
+            try:
+                for e in reader():
+                    if not in_q.push(pickle.dumps(e, protocol=4)):
+                        return
+            finally:
+                in_q.close()
+
+        def work():
+            while True:
+                item = in_q.pop()
+                if item is None:
+                    break
+                out = mapper(pickle.loads(item))
+                if not out_q.push(pickle.dumps(out, protocol=4)):
+                    break
+            with done_lock:
+                n_done[0] += 1
+                if n_done[0] == process_num:
+                    out_q.close()
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        while True:
+            item = out_q.pop()
+            if item is None:
+                break
+            yield pickle.loads(item)
+
+    return data_reader

@@ -1,0 +1,130 @@
+"""RecordIO reader/writer — native C++ backed, pure-Python fallback
+(reference: paddle/fluid/recordio/ + python recordio_writer.py)."""
+
+import struct
+
+from paddle_tpu.native import lib as _native_lib
+
+_MAGIC = 0x43525450
+
+
+def _crc32(data):
+    import zlib
+
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+class Writer:
+    def __init__(self, path, max_records=1024, max_bytes=1 << 20):
+        self._native = _native_lib()
+        self._path = path
+        if self._native is not None:
+            self._h = self._native.rio_writer_open(
+                path.encode(), max_records, max_bytes)
+            if not self._h:
+                raise IOError("cannot open %s" % path)
+        else:
+            self._f = open(path, "wb")
+            self._buf = b""
+            self._n = 0
+            self._max_records = max_records
+            self._max_bytes = max_bytes
+
+    def write(self, record: bytes):
+        if self._native is not None:
+            rc = self._native.rio_writer_write(self._h, record, len(record))
+            if rc != 0:
+                raise IOError("write failed on %s" % self._path)
+            return
+        self._buf += struct.pack("<I", len(record)) + record
+        self._n += 1
+        if self._n >= self._max_records or len(self._buf) >= self._max_bytes:
+            self._flush()
+
+    def _flush(self):
+        if self._n == 0:
+            return
+        self._f.write(struct.pack("<IIQI", _MAGIC, self._n, len(self._buf),
+                                  _crc32(self._buf)))
+        self._f.write(self._buf)
+        self._buf = b""
+        self._n = 0
+
+    def close(self):
+        if self._native is not None:
+            if self._h:
+                self._native.rio_writer_close(self._h)
+                self._h = None
+            return
+        self._flush()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class Reader:
+    def __init__(self, path):
+        self._native = _native_lib()
+        self._path = path
+        if self._native is not None:
+            self._h = self._native.rio_reader_open(path.encode())
+            if not self._h:
+                raise IOError("cannot open %s" % path)
+        else:
+            self._f = open(path, "rb")
+            self._records = []
+            self._idx = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._native is not None:
+            import ctypes
+
+            out = ctypes.c_char_p()
+            n = self._native.rio_reader_next(self._h, ctypes.byref(out))
+            if n == -1:
+                raise StopIteration
+            if n < 0:
+                raise IOError("corrupt recordio file %s" % self._path)
+            return ctypes.string_at(out, n)
+        while self._idx >= len(self._records):
+            head = self._f.read(20)
+            if len(head) < 20:
+                raise StopIteration
+            magic, n, plen, crc = struct.unpack("<IIQI", head)
+            if magic != _MAGIC:
+                raise IOError("corrupt recordio file %s" % self._path)
+            payload = self._f.read(plen)
+            if len(payload) != plen or _crc32(payload) != crc:
+                raise IOError("corrupt recordio file %s" % self._path)
+            self._records = []
+            off = 0
+            for _ in range(n):
+                (ln,) = struct.unpack_from("<I", payload, off)
+                off += 4
+                self._records.append(payload[off:off + ln])
+                off += ln
+            self._idx = 0
+        rec = self._records[self._idx]
+        self._idx += 1
+        return rec
+
+    def close(self):
+        if self._native is not None:
+            if self._h:
+                self._native.rio_reader_close(self._h)
+                self._h = None
+            return
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
