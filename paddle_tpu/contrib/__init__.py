@@ -1,0 +1,7 @@
+"""contrib: quantization (slim QAT + INT8 post-training calibration) —
+the fork's headline capability (reference:
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py and
+contrib/int8_inference/utility.py)."""
+
+from paddle_tpu.contrib import slim  # noqa: F401
+from paddle_tpu.contrib import int8_inference  # noqa: F401

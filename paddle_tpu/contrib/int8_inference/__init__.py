@@ -1,0 +1,1 @@
+from paddle_tpu.contrib.int8_inference.utility import Calibrator  # noqa: F401

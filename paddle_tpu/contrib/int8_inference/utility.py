@@ -1,0 +1,48 @@
+"""Post-training INT8 calibration (reference:
+python/paddle/fluid/contrib/int8_inference/utility.py Calibrator — the
+fork's headline flow: run FP32 inference over a sample set, collect
+activation ranges, emit an INT8 program)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.contrib.slim.quantization import (
+    QuantizationTransformPass,
+    QuantizationFreezePass,
+)
+
+
+class Calibrator:
+    """Collects abs-max activation statistics by running the float program
+    over calibration batches, then freezes an INT8 inference program."""
+
+    def __init__(self, program, scope, exe, feed_names, fetch_list,
+                 algo="abs_max"):
+        self.program = program
+        self.scope = scope
+        self.exe = exe
+        self.feed_names = feed_names
+        self.fetch_list = fetch_list
+        self.algo = algo
+
+    def calibrate_and_freeze(self, batches):
+        """batches: iterable of feed dicts. Returns the INT8 program."""
+        with fluid.scope_guard(self.scope):
+            # 1. instrument with observers (moving-average abs-max)
+            pass_ = QuantizationTransformPass(scope=self.scope)
+            pass_.apply(self.program)
+            # 2. run calibration batches with observers live (program-level
+            #    is_test off; per-op is_test attrs from the clone still hold
+            #    for dropout/BN, so only the observers change behavior)
+            was_test = getattr(self.program, "_is_test", False)
+            self.program._is_test = False
+            try:
+                for feed in batches:
+                    self.exe.run(self.program, feed=feed,
+                                 fetch_list=self.fetch_list)
+            finally:
+                self.program._is_test = was_test
+            # 3. freeze to int8
+            freeze = QuantizationFreezePass(self.scope)
+            freeze.apply(self.program)
+        return self.program

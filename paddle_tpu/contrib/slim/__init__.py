@@ -1,0 +1,1 @@
+from paddle_tpu.contrib.slim import quantization  # noqa: F401

@@ -1,0 +1,4 @@
+from paddle_tpu.contrib.slim.quantization.quantization_pass import (  # noqa: F401
+    QuantizationTransformPass,
+    QuantizationFreezePass,
+)

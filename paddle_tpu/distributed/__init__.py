@@ -1,0 +1,6 @@
+"""Distributed launch + coordination (reference:
+python/paddle/distributed/launch.py; the DCN bootstrap role of
+gen_nccl_id_op.cc is played by the PJRT coordinator — see
+paddle_tpu.parallel.env.init_distributed)."""
+
+from paddle_tpu.distributed.launch import launch_processes  # noqa: F401

@@ -1,0 +1,56 @@
+"""Multi-process launcher (reference: python/paddle/distributed/launch.py —
+spawns one worker per device/host setting PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINER_ENDPOINTS;
+launch.py:24-53). On TPU one process drives all local chips, so
+``nproc_per_node`` defaults to 1 per host; multi-host jobs get the
+coordinator env consumed by parallel.env.init_distributed.
+
+Usage:  python -m paddle_tpu.distributed.launch --nproc 2 train.py [args]
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_processes(script_args, nproc=1, started_port=6170,
+                     node_ip="127.0.0.1", env_extra=None):
+    endpoints = [
+        "%s:%d" % (node_ip, started_port + i) for i in range(nproc)
+    ]
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = str(nproc)
+        env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        # rank 0 hosts the PJRT coordinator (the gen_nccl_id analog)
+        env["COORDINATOR_ADDRESS"] = endpoints[0]
+        cmd = [sys.executable] + list(script_args)
+        procs.append(subprocess.Popen(cmd, env=env))
+    return procs
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc", "--nproc_per_node", type=int, default=1)
+    parser.add_argument("--started_port", type=int, default=6170)
+    parser.add_argument("--node_ip", default="127.0.0.1")
+    parser.add_argument("script", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.script:
+        parser.error("no training script given")
+    procs = launch_processes(args.script, args.nproc, args.started_port,
+                             args.node_ip)
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,15 @@ from paddle_tpu.parallel_executor import (  # noqa: F401
     BuildStrategy,
 )
 from paddle_tpu import io  # noqa: F401
+from paddle_tpu import transpiler  # noqa: F401
+from paddle_tpu.transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    InferenceTranspiler,
+    memory_optimize,
+    release_memory,
+)
+from paddle_tpu import contrib  # noqa: F401
 from paddle_tpu import recordio  # noqa: F401
 from paddle_tpu import reader  # noqa: F401
 from paddle_tpu.executor import EOFException  # noqa: F401

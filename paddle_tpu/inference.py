@@ -1,0 +1,94 @@
+"""Inference engine: AnalysisConfig/Predictor facade over AOT-compiled XLA
+(reference: paddle/fluid/inference/api/analysis_predictor.cc —
+CreatePaddlePredictor:734, Run:183, ZeroCopyTensor; analysis passes =
+XLA compilation here, SURVEY.md §3.5)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.executor import Executor
+from paddle_tpu.io import load_inference_model
+from paddle_tpu.platform import CPUPlace, TPUPlace
+
+
+class AnalysisConfig:
+    """(reference: paddle_analysis_config.h). GPU knobs map to the TPU
+    accelerator; MKLDNN/TensorRT knobs are accepted and ignored (XLA plays
+    both roles)."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+        self._use_accelerator = True
+        self._batch_warmup_shapes = None
+
+    def disable_gpu(self):
+        self._use_accelerator = False
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self._use_accelerator = True
+
+    # accepted for API parity; XLA subsumes these engines
+    def enable_mkldnn(self):
+        pass
+
+    def enable_tensorrt_engine(self, **kwargs):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+
+class PaddleTensor:
+    """Plain container matching the reference's PaddleTensor."""
+
+    def __init__(self, data=None, name=None):
+        self.name = name
+        self.data = np.asarray(data) if data is not None else None
+
+    @property
+    def shape(self):
+        return list(self.data.shape) if self.data is not None else None
+
+
+class AnalysisPredictor:
+    def __init__(self, config):
+        self.config = config
+        place = TPUPlace() if config._use_accelerator else CPUPlace()
+        self._exe = Executor(place)
+        self._scope = Scope()
+        with fluid.scope_guard(self._scope):
+            (self._program, self._feed_names,
+             self._fetch_vars) = load_inference_model(
+                config.model_dir, self._exe,
+                params_filename=config.params_file)
+        self._fetch_names = [
+            f.name if hasattr(f, "name") else str(f)
+            for f in self._fetch_vars
+        ]
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def run(self, inputs):
+        """inputs: list of PaddleTensor (positional by feed order) or dict
+        name->array. Returns list of PaddleTensor."""
+        if isinstance(inputs, dict):
+            feed = {k: np.asarray(v) for k, v in inputs.items()}
+        else:
+            feed = {}
+            for name, t in zip(self._feed_names, inputs):
+                feed[t.name or name] = t.data
+        with fluid.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names)
+        return [PaddleTensor(o, n) for o, n in zip(outs, self._fetch_names)]
+
+
+def create_paddle_predictor(config):
+    """(reference: analysis_predictor.cc:734 factory)."""
+    return AnalysisPredictor(config)

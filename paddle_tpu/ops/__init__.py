@@ -16,3 +16,4 @@ from paddle_tpu.ops import optimizer_ops  # noqa: F401
 from paddle_tpu.ops import metric_ops  # noqa: F401
 from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import controlflow_ops  # noqa: F401
+from paddle_tpu.ops import quant_ops  # noqa: F401

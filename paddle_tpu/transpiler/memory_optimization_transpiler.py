@@ -1,0 +1,17 @@
+"""Memory-optimization transpiler API (reference:
+python/paddle/fluid/transpiler/memory_optimization_transpiler.py).
+
+The reference rewrites the program to reuse variable buffers by lifetime
+analysis. Under whole-block XLA compilation, buffer liveness/reuse is the
+compiler's job (XLA's buffer assignment already performs this analysis on
+the fused program), so these are intentional no-ops kept for script
+compatibility; `skip_opt_set` etc. are accepted."""
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program

@@ -1,0 +1,208 @@
+"""Aux subsystem tests: quantization (QAT + freeze + calibration),
+inference predictor, transpilers, launcher, profiler spans."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import models
+
+
+def _mlp_program(lr=0.05):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[64], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, img, label, pred, loss
+
+
+def _teacher_batches(n, batch=64, dim=64, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(dim, classes).astype(np.float32)
+    out = []
+    for _ in range(n):
+        x = rng.randn(batch, dim).astype(np.float32)
+        y = np.argmax(x @ W, 1).astype(np.int64).reshape(-1, 1)
+        out.append({"img": x, "label": y})
+    return out
+
+
+class TestQuantization:
+    def test_qat_trains_and_freezes_to_int8(self):
+        from paddle_tpu.contrib.slim.quantization import (
+            QuantizationTransformPass, QuantizationFreezePass)
+
+        main, startup, img, label, pred, loss = _mlp_program()
+        test_prog = main.clone(for_test=True)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        batches = _teacher_batches(40)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # warmup float training
+            for b in batches[:10]:
+                exe.run(main, feed=b, fetch_list=[loss])
+            # instrument for QAT
+            QuantizationTransformPass(scope=scope).apply(main)
+            qat_losses = []
+            for b in batches[10:]:
+                (l,) = exe.run(main, feed=b, fetch_list=[loss])
+                qat_losses.append(float(l))
+            assert qat_losses[-1] < qat_losses[0] * 1.1  # keeps training
+
+            # float reference predictions (pre-freeze, observer scales fixed)
+            x = batches[0]["img"]
+            (ref,) = exe.run(test_prog, feed={"img": x}, fetch_list=[pred])
+
+            # freeze the TEST program to int8 (same shared params)
+            QuantizationTransformPass(scope=scope).apply(test_prog)
+            QuantizationFreezePass(scope).apply(test_prog)
+            types = [op.type for op in test_prog.desc.global_block().ops]
+            assert "quantized_matmul" in types
+            assert not any(t.startswith("fake_quantize") for t in types)
+            (got,) = exe.run(test_prog, feed={"img": x}, fetch_list=[pred])
+        # int8 vs float logits: close but not identical
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert err < 0.1, err
+        assert (np.argmax(got, 1) == np.argmax(ref, 1)).mean() > 0.9
+
+    def test_calibrator_post_training(self):
+        from paddle_tpu.contrib.int8_inference import Calibrator
+
+        main, startup, img, label, pred, loss = _mlp_program()
+        infer_prog = main.clone(for_test=True)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        batches = _teacher_batches(8, seed=3)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for b in batches[:4]:
+                exe.run(main, feed=b, fetch_list=[loss])
+            x = batches[0]["img"]
+            (ref,) = exe.run(infer_prog, feed={"img": x}, fetch_list=[pred])
+        cal = Calibrator(infer_prog, scope, exe, ["img"], [pred])
+        int8_prog = cal.calibrate_and_freeze(
+            [{"img": b["img"]} for b in batches[4:]])
+        with fluid.scope_guard(scope):
+            (got,) = exe.run(int8_prog, feed={"img": x}, fetch_list=[pred])
+        assert (np.argmax(got, 1) == np.argmax(ref, 1)).mean() > 0.85
+
+
+class TestInferencePredictor:
+    def test_save_and_predict(self, tmp_path):
+        from paddle_tpu.inference import (
+            AnalysisConfig, create_paddle_predictor, PaddleTensor)
+
+        main, startup, img, label, pred, loss = _mlp_program()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        x = np.random.RandomState(0).randn(4, 64).astype(np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (ref,) = exe.run(main.clone(for_test=True), feed={"img": x},
+                             fetch_list=[pred])
+            fluid.io.save_inference_model(
+                str(tmp_path), ["img"], [pred], exe,
+                main_program=main.clone(for_test=True))
+
+        config = AnalysisConfig(str(tmp_path))
+        predictor = create_paddle_predictor(config)
+        assert predictor.get_input_names() == ["img"]
+        outs = predictor.run([PaddleTensor(x, "img")])
+        np.testing.assert_allclose(outs[0].data, ref, atol=1e-5)
+
+
+class TestTranspilers:
+    def test_distribute_transpiler_pserver_structure(self):
+        main, startup, img, label, pred, loss = _mlp_program()
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main,
+                    pservers="127.0.0.1:6170,127.0.0.1:6171", trainers=2,
+                    startup_program=startup)
+        trainer = t.get_trainer_program()
+        ttypes = [op.type for op in trainer.desc.global_block().ops]
+        assert "send" in ttypes and "recv" in ttypes
+        assert "sgd" not in ttypes  # optimizer moved to pservers
+
+        ps0 = t.get_pserver_program("127.0.0.1:6170")
+        root_types = [op.type for op in ps0.desc.global_block().ops]
+        assert root_types[-1] == "listen_and_serv"
+        lns = ps0.desc.global_block().ops[-1]
+        blocks = lns.attrs["optimize_blocks"]
+        assert blocks, "pserver owns at least one param's optimizer block"
+        for bidx in blocks:
+            sub_types = [op.type for op in ps0.desc.block(bidx).ops]
+            assert "sgd" in sub_types
+
+        # every param is owned by exactly one pserver
+        ps1 = t.get_pserver_program("127.0.0.1:6171")
+        n0 = len(lns.attrs["optimize_blocks"])
+        n1 = len(ps1.desc.global_block().ops[-1].attrs["optimize_blocks"])
+        assert n0 + n1 == len(main.all_parameters())
+
+    def test_collective_mode_passthrough(self):
+        main, startup, *_ = _mlp_program()
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.mode = "nccl2"
+        t = fluid.DistributeTranspiler(config=cfg)
+        t.transpile(trainer_id=0, program=main,
+                    trainers="127.0.0.1:6170,127.0.0.1:6171")
+        assert t.get_trainer_program() is main
+
+    def test_inference_transpiler_folds_bn(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                    dtype="float32")
+            c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                    padding=1, bias_attr=False)
+            out = fluid.layers.batch_norm(input=c, is_test=True)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # non-trivial BN stats
+            for v, val in (("mean", 0.3), ("var", 2.0)):
+                pass
+            (ref,) = exe.run(main, feed={"img": x}, fetch_list=[out])
+            fluid.InferenceTranspiler().transpile(main, scope=scope)
+            types = [op.type for op in main.desc.global_block().ops]
+            assert "batch_norm" not in types
+            (got,) = exe.run(main, feed={"img": x}, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+
+    def test_memory_optimize_noop(self):
+        main, *_ = _mlp_program()
+        assert fluid.memory_optimize(main) is main
+
+
+class TestLauncher:
+    def test_spawns_ranked_processes(self, tmp_path):
+        from paddle_tpu.distributed import launch_processes
+
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os\n"
+            "print(os.environ['PADDLE_TRAINER_ID'],"
+            " os.environ['PADDLE_TRAINERS_NUM'],"
+            " os.environ['PADDLE_CURRENT_ENDPOINT'])\n")
+        procs = launch_processes([str(script)], nproc=2)
+        for p in procs:
+            assert p.wait(timeout=60) == 0
+
+
+class TestProfiler:
+    def test_record_event_span(self):
+        with fluid.profiler.record_event("unit-test-span"):
+            x = np.ones(4).sum()
+        assert x == 4
