@@ -2,71 +2,143 @@
 """Benchmark driver: prints ONE JSON line
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
-Measures training throughput (examples/sec) the same way the reference's
-benchmark harness does (reference: benchmark/fluid/fluid_benchmark.py:297-301
-— num_samples/elapsed per pass) on the flagship config. Runs on whatever
-device JAX_PLATFORMS selects (the real TPU chip under the driver).
+Measures training throughput exactly the way the reference harness defines
+it — examples/sec = num_samples / elapsed per pass (reference:
+benchmark/fluid/fluid_benchmark.py:297-301) — on the flagship config.
+Primary metric: ResNet-50 train images/sec on whatever device JAX selects
+(the real TPU chip under the driver). Extra metrics (BERT-base samples/sec,
+MNIST MLP examples/sec) ride along as additional keys. Select with
+PADDLE_TPU_BENCH=resnet50|bert|mnist|all (default resnet50+mnist).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
+def _throughput(run_step, batch, steps, warmup):
+    for _ in range(warmup):
+        run_step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = run_step()
+    # fetch forces host sync; out already numpy
+    elapsed = time.perf_counter() - t0
+    return batch * steps / elapsed, float(np.asarray(out).reshape(-1)[0])
+
+
 def bench_mnist_mlp(batch=512, steps=50, warmup=10):
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu import models
 
-    main = Program()
-    startup = Program()
-    with program_guard(main, startup):
-        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
-        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-        h = fluid.layers.fc(input=img, size=512, act="relu")
-        h2 = fluid.layers.fc(input=h, size=512, act="relu")
-        pred = fluid.layers.fc(input=h2, size=10, act=None)
-        loss = fluid.layers.softmax_with_cross_entropy(logits=pred, label=label)
-        avg_loss = fluid.layers.mean(loss)
-        opt = fluid.optimizer.SGD(learning_rate=0.01)
-        opt.minimize(avg_loss)
-
+    main, startup, h = models.mnist.get_model(lr=0.01)
     exe = fluid.Executor()
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
     x = rng.randn(batch, 784).astype(np.float32)
     y = rng.randint(0, 10, (batch, 1)).astype(np.int64)
-
     with fluid.scope_guard(scope):
         exe.run(startup)
-        for _ in range(warmup):
-            exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_loss])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            (l,) = exe.run(main, feed={"img": x, "label": y},
-                           fetch_list=[avg_loss])
-        elapsed = time.perf_counter() - t0
-    return batch * steps / elapsed
+        step = lambda: exe.run(main, feed={"img": x, "label": y},
+                               fetch_list=[h["loss"]])[0]
+        ips, loss = _throughput(step, batch, steps, warmup)
+    return ips
+
+
+def bench_resnet50(batch=None, steps=20, warmup=5):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch = batch or (64 if on_tpu else 4)
+    main, startup, h = models.resnet.get_model(
+        dataset="imagenet", depth=50, class_num=1000, lr=0.1)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    # pre-stage the batch on device: measures the compute pipeline the way
+    # the reference's double-buffered reader does (transfer overlapped),
+    # not the host link
+    x = jax.device_put(rng.randn(batch, 3, 224, 224).astype(np.float32))
+    y = jax.device_put(
+        rng.randint(0, 1000, (batch, 1)).astype(np.int64))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        step = lambda: exe.run(main, feed={"img": x, "label": y},
+                               fetch_list=[h["loss"]])[0]
+        ips, loss = _throughput(step, batch, steps, warmup)
+    assert np.isfinite(loss)
+    return ips
+
+
+def bench_bert_base(batch=None, steps=10, warmup=3, seq_len=128):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch = batch or (8 if on_tpu else 2)
+    if not on_tpu:
+        kwargs = dict(d_model=128, n_layers=2, n_heads=2, d_inner=256)
+    else:
+        kwargs = dict(d_model=768, n_layers=12, n_heads=12, d_inner=3072)
+    main, startup, h = models.bert.get_model(
+        batch_size=batch, seq_len=seq_len, vocab_size=30522, dropout=0.1,
+        lr=1e-4, max_position=512, **kwargs)
+    b = models.bert.make_fake_batch(batch, seq_len, 30522,
+                                    kwargs["n_heads"])
+    b = {k: jax.device_put(v) for k, v in b.items()}
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        step = lambda: exe.run(main, feed=b, fetch_list=[h["loss"]])[0]
+        sps, loss = _throughput(step, batch, steps, warmup)
+    assert np.isfinite(loss)
+    return sps
 
 
 def main():
-    try:
-        ips = bench_mnist_mlp()
-        print(json.dumps({
-            "metric": "mnist_mlp_train_examples_per_sec",
-            "value": round(float(ips), 2),
-            "unit": "examples/sec",
-            "vs_baseline": None,
-        }))
-    except Exception as e:  # never leave the driver without a JSON line
-        print(json.dumps({
-            "metric": "mnist_mlp_train_examples_per_sec",
-            "value": 0.0,
-            "unit": "examples/sec",
-            "vs_baseline": None,
-            "error": str(e)[:200],
-        }))
+    which = os.environ.get("PADDLE_TPU_BENCH", "default")
+    result = {
+        "metric": "resnet50_train_images_per_sec",
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": None,  # reference publishes no absolute throughput
+    }
+    errors = {}
+
+    def _try(name, fn):
+        try:
+            return round(float(fn()), 2)
+        except Exception as e:  # noqa: BLE001
+            errors[name] = str(e)[:200]
+            return None
+
+    if which in ("default", "all", "resnet50"):
+        v = _try("resnet50", bench_resnet50)
+        if v:
+            result["value"] = v
+    if which in ("all", "bert"):
+        v = _try("bert", bench_bert_base)
+        if v:
+            result["bert_base_samples_per_sec"] = v
+    if which in ("default", "all", "mnist") or result["value"] == 0.0:
+        v = _try("mnist", bench_mnist_mlp)
+        if v:
+            result["mnist_mlp_examples_per_sec"] = v
+            if result["value"] == 0.0:
+                result["metric"] = "mnist_mlp_train_examples_per_sec"
+                result["unit"] = "examples/sec"
+                result["value"] = v
+    if errors:
+        result["errors"] = errors
+    print(json.dumps(result))
+    if result["value"] == 0.0:
         sys.exit(1)
 
 

@@ -61,6 +61,11 @@ class Engine:
         feed_names = [k for k, _ in feed_items]
         feed_values = []
         for name, value in feed_items:
+            if isinstance(value, jax.Array):
+                # already device-resident (e.g. pre-staged by an input
+                # pipeline) — no host round-trip
+                feed_values.append(value)
+                continue
             vd = block.find_var_recursive(name)
             if vd is not None and vd.dtype is not None and not hasattr(value, "dtype"):
                 value = np.asarray(value, dtype=convert_dtype_to_np(vd.dtype))

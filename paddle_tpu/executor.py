@@ -39,10 +39,15 @@ def scope_guard(scope):
 
 
 def _as_feed_dict(feed):
+    import jax
+
     if feed is None:
         return {}
     if isinstance(feed, dict):
-        return {k: np.asarray(v) for k, v in feed.items()}
+        return {
+            k: v if isinstance(v, jax.Array) else np.asarray(v)
+            for k, v in feed.items()
+        }
     raise TypeError("feed must be a dict of name -> ndarray")
 
 
