@@ -54,9 +54,12 @@ def bench_resnet50(batch=None, steps=20, warmup=5):
     from paddle_tpu import models
 
     on_tpu = jax.default_backend() != "cpu"
-    batch = batch or (64 if on_tpu else 4)
+    # batch 512 amortizes per-step host latency and fills the MXU (bf16)
+    batch = batch or (512 if on_tpu else 4)
     main, startup, h = models.resnet.get_model(
         dataset="imagenet", depth=50, class_num=1000, lr=0.1)
+    if os.environ.get("PADDLE_TPU_AMP", "1") != "0":
+        fluid.contrib.mixed_precision.enable_bf16(main)
     exe = fluid.Executor()
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
@@ -81,7 +84,7 @@ def bench_bert_base(batch=None, steps=10, warmup=3, seq_len=128):
     from paddle_tpu import models
 
     on_tpu = jax.default_backend() != "cpu"
-    batch = batch or (8 if on_tpu else 2)
+    batch = batch or (64 if on_tpu else 2)
     if not on_tpu:
         kwargs = dict(d_model=128, n_layers=2, n_heads=2, d_inner=256)
     else:
@@ -89,6 +92,8 @@ def bench_bert_base(batch=None, steps=10, warmup=3, seq_len=128):
     main, startup, h = models.bert.get_model(
         batch_size=batch, seq_len=seq_len, vocab_size=30522, dropout=0.1,
         lr=1e-4, max_position=512, **kwargs)
+    if os.environ.get("PADDLE_TPU_AMP", "1") != "0":
+        fluid.contrib.mixed_precision.enable_bf16(main)
     b = models.bert.make_fake_batch(batch, seq_len, 30522,
                                     kwargs["n_heads"])
     b = {k: jax.device_put(v) for k, v in b.items()}

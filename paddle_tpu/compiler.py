@@ -116,6 +116,7 @@ class CompiledProgram:
                 is_test=getattr(self._program, "_is_test", False),
                 return_numpy=return_numpy,
                 seed=getattr(self._program, "random_seed", 0) or 0,
+                amp=getattr(self._program, "_amp", False),
             )
         mesh = self._get_mesh()
         fetch_names = [
@@ -128,6 +129,7 @@ class CompiledProgram:
             is_test=getattr(self._program, "_is_test", False),
             return_numpy=return_numpy,
             seed=getattr(self._program, "random_seed", 0) or 0,
+            amp=getattr(self._program, "_amp", False),
             cache_key_extra=(
                 "spmd", tuple(mesh.shape.items()), id(self._shard_rules),
                 self._data_axes,

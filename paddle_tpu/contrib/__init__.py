@@ -5,3 +5,4 @@ contrib/int8_inference/utility.py)."""
 
 from paddle_tpu.contrib import slim  # noqa: F401
 from paddle_tpu.contrib import int8_inference  # noqa: F401
+from paddle_tpu.contrib import mixed_precision  # noqa: F401

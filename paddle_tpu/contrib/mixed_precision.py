@@ -1,0 +1,41 @@
+"""Mixed precision (bfloat16) training.
+
+Reference precedent: the fp16 `float16` type + data_type_transform
+machinery (paddle/fluid/platform/float16.h, framework/data_type_transform.cc).
+TPU-native: bfloat16 is the MXU's native compute type and needs no loss
+scaling — matmul/conv lowerings cast operands to bf16 and accumulate in
+fp32, while parameters/optimizer state stay fp32 (master weights by
+construction, since program state is never cast).
+"""
+
+
+def enable_bf16(program):
+    """Mark the program for bf16 compute (matmuls/convs); returns it."""
+    program._amp = True
+    return program
+
+
+def disable_bf16(program):
+    program._amp = False
+    return program
+
+
+class _DecoratedOptimizer:
+    def __init__(self, optimizer):
+        self._opt = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def minimize(self, loss, **kwargs):
+        enable_bf16(loss.block.program)
+        return self._opt.minimize(loss, **kwargs)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             use_dynamic_loss_scaling=False):
+    """fluid.contrib.mixed_precision.decorate-compatible entry: wraps the
+    optimizer so minimize() turns on bf16 compute for the program. The
+    loss-scaling knobs are accepted and unused (bf16's fp32-sized exponent
+    needs none)."""
+    return _DecoratedOptimizer(optimizer)

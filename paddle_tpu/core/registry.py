@@ -16,6 +16,28 @@ registered with
 """
 
 
+import contextlib
+import contextvars
+
+# Mixed-precision trace mode: while set, matmul/conv lowerings compute in
+# bfloat16 with float32 accumulation (MXU-native), parameters staying
+# float32 ("master weights" fall out for free since state is never cast).
+_amp_mode = contextvars.ContextVar("paddle_tpu_amp", default=False)
+
+
+def amp_enabled():
+    return _amp_mode.get()
+
+
+@contextlib.contextmanager
+def amp_scope(enabled):
+    token = _amp_mode.set(bool(enabled))
+    try:
+        yield
+    finally:
+        _amp_mode.reset(token)
+
+
 class OpInfo:
     def __init__(self, type):
         self.type = type

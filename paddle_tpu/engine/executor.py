@@ -52,6 +52,7 @@ class Engine:
         mesh=None,
         shard_rules=None,
         data_axes=("dp",),
+        amp=False,
     ):
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -80,6 +81,7 @@ class Engine:
             tuple(fetch_list),
             is_test,
             donate_state,
+            amp,
             cache_key_extra,
         )
 
@@ -88,7 +90,7 @@ class Engine:
             compiled = self._compile(
                 block, feed_names, fetch_list, is_test, donate_state,
                 mesh=mesh, feed_values=feed_values,
-                shard_rules=shard_rules, data_axes=data_axes,
+                shard_rules=shard_rules, data_axes=data_axes, amp=amp,
             )
             self._cache[key] = compiled
 
@@ -121,9 +123,9 @@ class Engine:
     # -- internals ---------------------------------------------------------
     def _compile(self, block, feed_names, fetch_list, is_test, donate_state,
                  mesh=None, feed_values=None, shard_rules=None,
-                 data_axes=("dp",)):
+                 data_axes=("dp",), amp=False):
         bp = BlockProgram(block, feed_names, fetch_list, ())
-        fn = lower_block(bp, is_test=is_test, executor=self)
+        fn = lower_block(bp, is_test=is_test, executor=self, amp=amp)
 
         out_set = set(bp.state_out_names)
         mutated = [n for n in bp.state_in_names if n in out_set]

@@ -142,9 +142,11 @@ def _op_needs_rng(op):
     return OpRegistry.get(base).needs_rng
 
 
-def lower_block(block_program, is_test=False, executor=None):
+def lower_block(block_program, is_test=False, executor=None, amp=False):
     """Returns fn(feeds: list, state_in: list, rng_key) ->
     (fetches: list, state_out: list)."""
+    from paddle_tpu.core.registry import amp_scope
+
     block = block_program.block
     feed_names = block_program.feed_names
     state_in_names = block_program.state_in_names
@@ -156,8 +158,9 @@ def lower_block(block_program, is_test=False, executor=None):
         for name, val in zip(state_in_names, state_values):
             env[name] = val
 
-        for op_index, op in enumerate(block_program.ops):
-            run_op(op, block, env, rng_key, op_index, is_test, executor)
+        with amp_scope(amp):
+            for op_index, op in enumerate(block_program.ops):
+                run_op(op, block, env, rng_key, op_index, is_test, executor)
 
         fetches = [env[n] for n in block_program.fetch_names]
         state_out = [env[n] for n in block_program.state_out_names]

@@ -99,4 +99,5 @@ class Executor:
             is_test=getattr(program, "_is_test", False),
             return_numpy=return_numpy,
             seed=getattr(program, "random_seed", 0) or 0,
+            amp=getattr(program, "_amp", False),
         )

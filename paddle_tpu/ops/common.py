@@ -2,6 +2,22 @@
 
 import jax.numpy as jnp
 
+from paddle_tpu.core.registry import amp_enabled
+
+
+def amp_cast(*xs):
+    """Under AMP, cast float32 operands to bfloat16 (compute dtype); pair
+    with preferred_element_type=float32 so accumulation stays fp32."""
+    if not amp_enabled():
+        return xs if len(xs) > 1 else xs[0]
+    out = tuple(
+        x.astype(jnp.bfloat16)
+        if x is not None and hasattr(x, "dtype") and x.dtype == jnp.float32
+        else x
+        for x in xs
+    )
+    return out if len(out) > 1 else out[0]
+
 
 def bcast_y_to_x(x, y, axis):
     """Fluid elementwise broadcast: align Y's dims to X starting at ``axis``

@@ -9,7 +9,9 @@ neighbors.
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
-from paddle_tpu.ops.common import bcast_y_to_x, flatten_to_2d, single
+from paddle_tpu.ops.common import (
+    amp_cast, bcast_y_to_x, flatten_to_2d, single,
+)
 
 
 @register_op("mul")
@@ -20,7 +22,8 @@ def mul(ctx, ins, attrs):
     ync = attrs.get("y_num_col_dims", 1)
     x2 = flatten_to_2d(x, xnc)
     y2 = flatten_to_2d(y, ync)
-    out = x2 @ y2
+    x2, y2 = amp_cast(x2, y2)
+    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32)
     out_shape = x.shape[:xnc] + y.shape[ync:]
     return {"Out": [out.reshape(out_shape)]}
 
@@ -36,7 +39,10 @@ def matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if ty:
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = jnp.matmul(x, y)
+    x, y = amp_cast(x, y)
+    pet = jnp.float32 if jnp.issubdtype(
+        jnp.result_type(x, y), jnp.floating) else None
+    out = jnp.matmul(x, y, preferred_element_type=pet)
     if alpha != 1.0:
         out = out * alpha
     return {"Out": [out]}

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.core.registry import register_op
-from paddle_tpu.ops.common import single
+from paddle_tpu.ops.common import amp_cast, single
 
 
 def _conv_dn(ndim):
@@ -34,7 +34,12 @@ def conv2d(ctx, ins, attrs):
     dilations = tuple(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
     pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    orig_dtype = x.dtype
+    x, w = amp_cast(x, w)
     dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    # Under AMP the conv runs wholly in bf16 (the MXU accumulates fp32
+    # internally) and the result is cast back — mixing operand dtype and
+    # preferred_element_type breaks the conv transpose rule in vjp.
     out = lax.conv_general_dilated(
         x,
         w,
@@ -43,8 +48,11 @@ def conv2d(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+        preferred_element_type=(
+            jnp.float32 if x.dtype == jnp.float32 else None),
     )
+    if out.dtype != orig_dtype and orig_dtype == jnp.float32:
+        out = out.astype(orig_dtype)
     return {"Output": [out]}
 
 
