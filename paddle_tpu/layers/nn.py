@@ -83,6 +83,10 @@ __all__ = [
     "logical_or",
     "logical_xor",
     "logical_not",
+    "dynamic_lstm",
+    "dynamic_gru",
+    "beam_search",
+    "beam_search_decode",
 ]
 
 
@@ -1056,6 +1060,120 @@ less_than = _cmp_layer("less_than")
 less_equal = _cmp_layer("less_equal")
 greater_than = _cmp_layer("greater_than")
 greater_equal = _cmp_layer("greater_equal")
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, seq_len=None,
+                 param_attr=None, bias_attr=None, use_peepholes=False,
+                 is_reverse=False, gate_activation="sigmoid",
+                 cell_activation="tanh", candidate_activation="tanh",
+                 dtype="float32", name=None):
+    """LSTM over a padded [B, T, 4H] pre-projected input (reference:
+    layers/nn.py:370 — the LoD-batched form becomes padded+masked via
+    ``seq_len``). Returns (hidden [B,T,H], cell [B,T,H])."""
+    helper = LayerHelper("dynamic_lstm", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    hidden_size = size // 4
+    weight = helper.create_parameter(
+        attr=param_attr, shape=[hidden_size, 4 * hidden_size], dtype=dtype)
+    n_bias = 7 * hidden_size if use_peepholes else 4 * hidden_size
+    bias = helper.create_parameter(
+        attr=bias_attr if bias_attr not in (None, True) else None,
+        shape=[1, n_bias], dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="dynamic_lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden, cell
+
+
+def dynamic_gru(input, size, h_0=None, seq_len=None, param_attr=None,
+                bias_attr=None, is_reverse=False,
+                gate_activation="sigmoid", candidate_activation="tanh",
+                dtype="float32", name=None):
+    """GRU over a padded [B, T, 3H] pre-projected input (reference:
+    layers/nn.py dynamic_gru). Returns hidden [B, T, H]."""
+    helper = LayerHelper("dynamic_gru", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    weight = helper.create_parameter(
+        attr=param_attr, shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(
+        attr=bias_attr if bias_attr not in (None, True) else None,
+        shape=[1, 3 * size], dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="dynamic_gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return hidden
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id,
+                first_step=False, name=None):
+    """One beam-search step (reference: layers/nn.py:3873 — fixed
+    batch*beam rows instead of LoD shrinking). Returns (selected_ids,
+    selected_scores, parent_idx)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference(scores.dtype)
+    parent = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "scores": [scores]},
+        outputs={"selected_ids": [sel_ids],
+                 "selected_scores": [sel_scores],
+                 "parent_idx": [parent]},
+        attrs={"beam_size": beam_size, "end_id": end_id,
+               "first_step": first_step},
+    )
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids_array, scores_array, parent_array, beam_size,
+                       end_id, name=None):
+    """Backtrack a finished beam decode from the step arrays (reference:
+    layers beam_search_decode). Returns (sentence_ids [BW, max_len],
+    sentence_scores [BW, 1])."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference("int64")
+    sent_scores = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids_array], "Scores": [scores_array],
+                "ParentIdx": [parent_array]},
+        outputs={"sentence_ids": [sent_ids],
+                 "sentence_scores": [sent_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sent_ids, sent_scores
 
 
 def _logical_layer(op_type, unary=False):

@@ -1,0 +1,104 @@
+"""Beam search ops.
+
+Reference: paddle/fluid/operators/beam_search_op.cc (+ math/beam_search.cu)
+and beam_search_decode_op.cc — LoD-based shrinking beams. TPU-native: the
+beam dimension stays a FIXED batch*beam rows tensor (static shapes for
+XLA); finished beams (pre_id == end_id) emit only end_id with a frozen
+cumulative score, which reproduces the reference's pruning semantics
+without dynamic shapes.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_no_grad_op
+from paddle_tpu.ops.common import single
+
+_NEG = -1e9
+
+
+@register_no_grad_op("beam_search")
+def beam_search(ctx, ins, attrs):
+    """One beam step over [batch*beam, V] log-probs.
+
+    Inputs: pre_ids [BW,1], pre_scores [BW,1], scores [BW,V] (log-probs).
+    Attrs: beam_size, end_id, first_step (only beam 0 live at step 0).
+    Outputs: selected_ids [BW,1], selected_scores [BW,1], parent_idx [BW]
+    (global row into the previous beam layout)."""
+    pre_ids = single(ins, "pre_ids").reshape(-1)       # [BW]
+    pre_scores = single(ins, "pre_scores").reshape(-1)  # [BW]
+    scores = single(ins, "scores")                      # [BW, V]
+    W = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    first = bool(attrs.get("first_step", False))
+
+    BW, V = scores.shape
+    B = BW // W
+
+    finished = pre_ids == end_id
+    # finished rows: only candidate is end_id at frozen score
+    cand = jnp.where(finished[:, None], _NEG, pre_scores[:, None] + scores)
+    end_col = jnp.full((BW, V), _NEG, scores.dtype).at[:, end_id].set(
+        jnp.where(finished, pre_scores, _NEG))
+    cand = jnp.maximum(cand, end_col)
+    if first:
+        # only the first beam of each group is live at step 0
+        beam_idx = jnp.arange(BW) % W
+        cand = jnp.where((beam_idx == 0)[:, None], cand, _NEG)
+
+    grouped = cand.reshape(B, W * V)
+    top_scores, top_flat = lax.top_k(grouped, W)        # [B, W]
+    parent_local = top_flat // V                         # beam within group
+    token = top_flat % V
+    parent_global = (jnp.arange(B)[:, None] * W + parent_local).reshape(-1)
+    return {
+        "selected_ids": [token.reshape(-1, 1).astype(jnp.int64)],
+        "selected_scores": [top_scores.reshape(-1, 1)],
+        "parent_idx": [parent_global.astype(jnp.int64)],
+    }
+
+
+@register_no_grad_op("beam_search_decode")
+def beam_search_decode(ctx, ins, attrs):
+    """Backtrack parent pointers over the whole decode.
+
+    Inputs: Ids / ParentIdx / Scores tensor-arrays (see controlflow_ops
+    arrays: {"buf": [cap, BW, ...], "len": i32}).
+    Outputs: sentence_ids [BW, cap] (end_id padded), sentence_scores
+    [BW, 1] (cumulative score at the final step)."""
+    ids_arr = single(ins, "Ids")
+    parent_arr = single(ins, "ParentIdx")
+    scores_arr = single(ins, "Scores")
+    end_id = int(attrs["end_id"])
+
+    ids = ids_arr["buf"]          # [cap, BW, 1]
+    parents = parent_arr["buf"]   # [cap, BW]
+    length = ids_arr["len"]       # live steps
+    cap, BW = ids.shape[0], ids.shape[1]
+
+    row0 = jnp.arange(BW)
+
+    def step(rows, t):
+        # walking backwards from the last live step; frozen beyond length
+        live = t < length
+        tok = jnp.where(
+            live,
+            lax.dynamic_index_in_dim(ids, jnp.maximum(t, 0), 0,
+                                     keepdims=False).reshape(-1)[rows],
+            jnp.int64(end_id) if ids.dtype == jnp.int64 else end_id,
+        )
+        par = lax.dynamic_index_in_dim(parents, jnp.maximum(t, 0), 0,
+                                       keepdims=False)[rows]
+        new_rows = jnp.where(live, par, rows)
+        return new_rows, tok
+
+    _, toks = lax.scan(step, row0, jnp.arange(cap - 1, -1, -1))
+    # toks is reversed in time: [cap, BW] with t descending
+    sent = jnp.flip(toks, axis=0).T                      # [BW, cap]
+    final_scores = lax.dynamic_index_in_dim(
+        scores_arr["buf"], jnp.maximum(length - 1, 0), 0,
+        keepdims=False).reshape(BW, 1)
+    return {
+        "sentence_ids": [sent.astype(jnp.int64)],
+        "sentence_scores": [final_scores],
+    }

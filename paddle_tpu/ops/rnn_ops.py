@@ -1,0 +1,133 @@
+"""Recurrent ops: dynamic_lstm, dynamic_gru.
+
+Reference: paddle/fluid/operators/lstm_op.cc + math/lstm_compute,
+gru_op.cc + math/gru_compute — LoD-batched kernels that reorder sequences
+by length. TPU-native: padded [B, T, ...] batches scanned with
+``lax.scan`` and per-timestep validity masking (the LoD story per
+SURVEY.md §5); differentiable through the generic vjp machinery.
+
+Gate layouts follow the reference:
+  LSTM projected input [B, T, 4H] in i, f, c, o order (lstm_op.cc).
+  GRU projected input [B, T, 3H] in update, reset, candidate order
+  (gru_op.cc).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import single
+
+
+def _act(name):
+    return {
+        "sigmoid": lambda x: 1.0 / (1.0 + jnp.exp(-x)),
+        "tanh": jnp.tanh,
+        "relu": lambda x: jnp.maximum(x, 0),
+        "identity": lambda x: x,
+    }[name]
+
+
+@register_op("dynamic_lstm", no_grad_inputs=("SeqLen",))
+def dynamic_lstm(ctx, ins, attrs):
+    x = single(ins, "Input")       # [B, T, 4H] pre-projected (x @ W_x)
+    w = single(ins, "Weight")      # [H, 4H] recurrent weights
+    bias = single(ins, "Bias")     # [1, 4H] (+ [1, 3H] peephole tail)
+    h0 = ins.get("H0", [None])[0]
+    c0 = ins.get("C0", [None])[0]
+    seq_len = ins.get("SeqLen", [None])[0]   # [B] int lengths, optional
+
+    B, T, H4 = x.shape
+    H = H4 // 4
+    use_peepholes = bool(attrs.get("use_peepholes", False))
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    reverse = bool(attrs.get("is_reverse", False))
+
+    gate_bias = bias[:, :4 * H]
+    if use_peepholes:
+        w_ic = bias[:, 4 * H:5 * H]
+        w_fc = bias[:, 5 * H:6 * H]
+        w_oc = bias[:, 6 * H:7 * H]
+
+    xt_seq = jnp.swapaxes(x, 0, 1)  # [T, B, 4H]
+    if reverse:
+        xt_seq = jnp.flip(xt_seq, axis=0)
+    h_prev = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, t = inp
+        gates = xt + h_prev @ w + gate_bias
+        i, f, c_hat, o = jnp.split(gates, 4, axis=1)
+        if use_peepholes:
+            i = i + c_prev * w_ic
+            f = f + c_prev * w_fc
+        i, f = gate_act(i), gate_act(f)
+        c = f * c_prev + i * cand_act(c_hat)
+        if use_peepholes:
+            o = o + c * w_oc
+        o = gate_act(o)
+        h = o * cell_act(c)
+        if seq_len is not None:
+            tt = (T - 1 - t) if reverse else t
+            valid = (tt < seq_len)[:, None]
+            h = jnp.where(valid, h, h_prev)
+            c = jnp.where(valid, c, c_prev)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = lax.scan(
+        step, (h_prev, c_prev), (xt_seq, jnp.arange(T)))
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+    }
+
+
+@register_op("dynamic_gru", no_grad_inputs=("SeqLen",))
+def dynamic_gru(ctx, ins, attrs):
+    x = single(ins, "Input")       # [B, T, 3H] pre-projected
+    w = single(ins, "Weight")      # [H, 3H]: [:, :2H] gates, [:, 2H:] cand
+    bias = ins.get("Bias", [None])[0]   # [1, 3H]
+    h0 = ins.get("H0", [None])[0]
+    seq_len = ins.get("SeqLen", [None])[0]
+
+    B, T, H3 = x.shape
+    H = H3 // 3
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    reverse = bool(attrs.get("is_reverse", False))
+
+    w_g = w[:, :2 * H]   # update+reset recurrent weights
+    w_c = w[:, 2 * H:]   # candidate recurrent weights
+
+    xt_seq = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xt_seq = jnp.flip(xt_seq, axis=0)
+    h_prev = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+
+    def step(h_prev, inp):
+        xt, t = inp
+        if bias is not None:
+            xt = xt + bias
+        xu, xr, xc = xt[:, :H], xt[:, H:2 * H], xt[:, 2 * H:]
+        gates = jnp.concatenate([xu, xr], 1) + h_prev @ w_g
+        u = gate_act(gates[:, :H])
+        r = gate_act(gates[:, H:])
+        c = cand_act(xc + (r * h_prev) @ w_c)
+        h = u * h_prev + (1.0 - u) * c
+        if seq_len is not None:
+            tt = (T - 1 - t) if reverse else t
+            valid = (tt < seq_len)[:, None]
+            h = jnp.where(valid, h, h_prev)
+        return h, h
+
+    _, hs = lax.scan(step, h_prev, (xt_seq, jnp.arange(T)))
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)]}

@@ -1,0 +1,279 @@
+"""dynamic_lstm / dynamic_gru numerics + beam search semantics."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, w, b, seq_len=None):
+    B, T, H4 = x.shape
+    H = H4 // 4
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    hs = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        gates = x[:, t] + h @ w + b
+        i, f, ch, o = np.split(gates, 4, axis=1)
+        i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+        c_new = f * c + i * np.tanh(ch)
+        h_new = o * np.tanh(c_new)
+        if seq_len is not None:
+            valid = (t < seq_len)[:, None]
+            h_new = np.where(valid, h_new, h)
+            c_new = np.where(valid, c_new, c)
+        h, c = h_new, c_new
+        hs[:, t] = h
+    return hs
+
+
+def _np_gru(x, w, b, seq_len=None):
+    B, T, H3 = x.shape
+    H = H3 // 3
+    h = np.zeros((B, H), np.float32)
+    hs = np.zeros((B, T, H), np.float32)
+    w_g, w_c = w[:, :2 * H], w[:, 2 * H:]
+    for t in range(T):
+        xt = x[:, t] + b
+        xu, xr, xc = xt[:, :H], xt[:, H:2 * H], xt[:, 2 * H:]
+        g = np.concatenate([xu, xr], 1) + h @ w_g
+        u, r = _sigmoid(g[:, :H]), _sigmoid(g[:, H:])
+        cand = np.tanh(xc + (r * h) @ w_c)
+        h_new = u * h + (1 - u) * cand
+        if seq_len is not None:
+            valid = (t < seq_len)[:, None]
+            h_new = np.where(valid, h_new, h)
+        h = h_new
+        hs[:, t] = h
+    return hs
+
+
+class TestDynamicLSTM:
+    def test_matches_numpy_with_masking(self):
+        B, T, H = 3, 6, 4
+        rng = np.random.RandomState(0)
+        xv = rng.randn(B, T, 4 * H).astype(np.float32)
+        lens = np.array([6, 3, 5], np.int64)
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[T, 4 * H],
+                                  dtype="float32")
+            sl = fluid.layers.data(name="sl", shape=[1], dtype="int64")
+            sl2 = fluid.layers.reshape(sl, shape=[-1])
+            hidden, cell = fluid.layers.dynamic_lstm(
+                input=x, size=4 * H, seq_len=sl2)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            params = main.all_parameters()
+            wv = np.asarray(scope.get([p for p in params
+                                       if ".w" in p.name][0].name))
+            bv = np.asarray(scope.get([p for p in params
+                                       if ".b" in p.name][0].name))
+            (got,) = exe.run(
+                main, feed={"x": xv, "sl": lens.reshape(-1, 1)},
+                fetch_list=[hidden])
+        want = _np_lstm(xv, wv, bv, lens)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+        # masked tail must hold the last valid state
+        np.testing.assert_allclose(got[1, 3], got[1, 2], atol=1e-6)
+
+    def test_trains(self):
+        B, T, H = 8, 5, 8
+        rng = np.random.RandomState(1)
+        xv = rng.randn(B, T, H).astype(np.float32)
+        yv = rng.randn(B, H).astype(np.float32)
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[T, H], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[H], dtype="float32")
+            proj = fluid.layers.fc(input=x, size=4 * H, num_flatten_dims=2)
+            hidden, _ = fluid.layers.dynamic_lstm(input=proj, size=4 * H)
+            last = fluid.layers.slice(hidden, axes=[1], starts=[T - 1],
+                                      ends=[T])
+            last = fluid.layers.reshape(last, shape=[-1, H])
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=last, label=y))
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(40):
+                (l,) = exe.run(main, feed={"x": xv, "y": yv},
+                               fetch_list=[loss])
+                losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+
+class TestDynamicGRU:
+    def test_matches_numpy(self):
+        B, T, H = 2, 4, 5
+        rng = np.random.RandomState(2)
+        xv = rng.randn(B, T, 3 * H).astype(np.float32)
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[T, 3 * H],
+                                  dtype="float32")
+            hidden = fluid.layers.dynamic_gru(input=x, size=H)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            params = main.all_parameters()
+            wv = np.asarray(scope.get([p for p in params
+                                       if ".w" in p.name][0].name))
+            bv = np.asarray(scope.get([p for p in params
+                                       if ".b" in p.name][0].name))
+            (got,) = exe.run(main, feed={"x": xv}, fetch_list=[hidden])
+        want = _np_gru(xv, wv, bv)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def _np_beam_step(pre_ids, pre_scores, scores, W, end_id, first=False):
+    BW, V = scores.shape
+    B = BW // W
+    sel_ids = np.zeros((BW,), np.int64)
+    sel_scores = np.zeros((BW,), np.float32)
+    parents = np.zeros((BW,), np.int64)
+    for b in range(B):
+        cands = []  # (score, parent_row, token)
+        for w in range(W):
+            r = b * W + w
+            if first and w != 0:
+                continue
+            if pre_ids[r] == end_id:
+                cands.append((pre_scores[r], r, end_id))
+            else:
+                for v in range(V):
+                    cands.append((pre_scores[r] + scores[r, v], r, v))
+        cands.sort(key=lambda t: -t[0])
+        for w in range(W):
+            s, r, v = cands[w]
+            sel_scores[b * W + w] = s
+            parents[b * W + w] = r
+            sel_ids[b * W + w] = v
+    return sel_ids, sel_scores, parents
+
+
+class TestBeamSearch:
+    def test_step_matches_numpy(self):
+        rng = np.random.RandomState(3)
+        B, W, V = 2, 3, 7
+        BW = B * W
+        pre_ids = rng.randint(0, V, (BW, 1)).astype(np.int64)
+        pre_ids[1, 0] = 0  # one finished beam (end_id=0)
+        pre_scores = rng.randn(BW, 1).astype(np.float32)
+        scores = np.log(
+            np.random.RandomState(4).dirichlet(np.ones(V), BW)
+        ).astype(np.float32)
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            pi = fluid.layers.data(name="pi", shape=[1], dtype="int64")
+            ps = fluid.layers.data(name="ps", shape=[1], dtype="float32")
+            sc = fluid.layers.data(name="sc", shape=[V], dtype="float32")
+            ids, scs, par = fluid.layers.beam_search(
+                pi, ps, sc, beam_size=W, end_id=0)
+        exe = fluid.Executor()
+        got_ids, got_scores, got_par = exe.run(
+            main, feed={"pi": pre_ids, "ps": pre_scores, "sc": scores},
+            fetch_list=[ids, scs, par])
+        want_ids, want_scores, want_par = _np_beam_step(
+            pre_ids.reshape(-1), pre_scores.reshape(-1), scores, W, 0)
+        np.testing.assert_array_equal(got_ids.reshape(-1), want_ids)
+        np.testing.assert_allclose(got_scores.reshape(-1), want_scores,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(got_par.reshape(-1), want_par)
+
+    def test_full_decode_loop_with_backtrack(self):
+        """In-program While decode driven by a fixed transition table; the
+        decoded argmax path must equal the independent numpy beam search."""
+        V, W, B, MAX_T = 6, 2, 1, 4
+        BW = B * W
+        end_id = 0
+        rng = np.random.RandomState(5)
+        # token-conditioned next-token log-probs (a toy LM)
+        table = np.log(rng.dirichlet(np.ones(V), V)).astype(np.float32)
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            table_v = fluid.layers.data(name="table", shape=[V, V],
+                                        dtype="float32",
+                                        append_batch_size=False)
+            start = fluid.layers.fill_constant(
+                shape=[BW, 1], dtype="int64", value=1)  # <s> token = 1
+            zero_scores = fluid.layers.fill_constant(
+                shape=[BW, 1], dtype="float32", value=0.0)
+
+            i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+            limit = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                               value=MAX_T)
+            ids_arr = fluid.layers.create_array("int64", capacity=MAX_T)
+            par_arr = fluid.layers.create_array("int64", capacity=MAX_T)
+            score_arr = fluid.layers.create_array("float32",
+                                                  capacity=MAX_T)
+
+            # step 0 outside the loop (first_step pruning), materializes
+            # the arrays
+            cur_scores = fluid.layers.gather(
+                table_v, fluid.layers.reshape(start, shape=[-1]))
+            ids0, scores0, par0 = fluid.layers.beam_search(
+                start, zero_scores, cur_scores, beam_size=W, end_id=end_id,
+                first_step=True)
+            fluid.layers.array_write(ids0, i, array=ids_arr)
+            fluid.layers.array_write(par0, i, array=par_arr)
+            fluid.layers.array_write(scores0, i, array=score_arr)
+            pre_ids = fluid.layers.assign(ids0)
+            pre_scores = fluid.layers.assign(scores0)
+            fluid.layers.increment(i, value=1, in_place=True)
+
+            cond = fluid.layers.less_than(x=i, y=limit)
+            w = fluid.While(cond=cond)
+            with w.block():
+                cur = fluid.layers.gather(
+                    table_v, fluid.layers.reshape(pre_ids, shape=[-1]))
+                ids_t, scores_t, par_t = fluid.layers.beam_search(
+                    pre_ids, pre_scores, cur, beam_size=W, end_id=end_id)
+                fluid.layers.array_write(ids_t, i, array=ids_arr)
+                fluid.layers.array_write(par_t, i, array=par_arr)
+                fluid.layers.array_write(scores_t, i, array=score_arr)
+                fluid.layers.assign(ids_t, output=pre_ids)
+                fluid.layers.assign(scores_t, output=pre_scores)
+                fluid.layers.increment(i, value=1, in_place=True)
+                fluid.layers.less_than(x=i, y=limit, cond=cond)
+
+            sent_ids, sent_scores = fluid.layers.beam_search_decode(
+                ids_arr, score_arr, par_arr, beam_size=W, end_id=end_id)
+
+        exe = fluid.Executor()
+        got_ids, got_scores = exe.run(
+            main, feed={"table": table},
+            fetch_list=[sent_ids, sent_scores])
+
+        # independent numpy beam search over the same table
+        pre_i = np.full((BW,), 1, np.int64)
+        pre_s = np.zeros((BW,), np.float32)
+        np_ids, np_pars = [], []
+        for t in range(MAX_T):
+            sc = table[pre_i]
+            ids_t, sc_t, par_t = _np_beam_step(
+                pre_i, pre_s, sc, W, end_id, first=(t == 0))
+            np_ids.append(ids_t)
+            np_pars.append(par_t)
+            pre_i, pre_s = ids_t, sc_t
+        # numpy backtrack of beam 0
+        rows = np.arange(BW)
+        seq = np.zeros((BW, MAX_T), np.int64)
+        for t in range(MAX_T - 1, -1, -1):
+            seq[:, t] = np_ids[t][rows]
+            rows = np_pars[t][rows]
+        np.testing.assert_array_equal(got_ids, seq)
+        np.testing.assert_allclose(got_scores.reshape(-1), pre_s, atol=1e-5)
