@@ -1,0 +1,273 @@
+"""Parameter-server runtime: the live transport behind the
+DistributeTranspiler's pserver mode.
+
+Reference: the C++ RPC stack — RPCClient/RPCServer with VariableMessage
+serde (paddle/fluid/operators/distributed/grpc/grpc_serde.cc,
+send_recv.proto.in), request handlers with send/get/fetch barriers
+(request_handler_impl.cc), and the listen_and_serv sync loop that waits for
+all trainers' gradients, runs one optimizer sub-block per parameter, then
+serves Get until the fetch barrier (listen_and_serv_op.cc:107-176
+RunSyncLoop). Graceful shutdown mirrors Executor::Close → SendComplete.
+
+This implementation keeps the same protocol state machine over a compact
+length-prefixed TCP framing (the image has no grpc); gradients from N
+trainers are averaged, then each parameter's optimizer sub-block runs on
+the XLA engine.
+"""
+
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+
+# -- framing ---------------------------------------------------------------
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    head = _recv_exact(sock, 8)
+    if head is None:
+        return None
+    (n,) = struct.unpack("<Q", head)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# -- server ----------------------------------------------------------------
+
+class ParameterServer:
+    """Executes one trainer-synchronous update loop per batch.
+
+    Protocol (per connection, any number of requests):
+      ("send", var_name, ndarray)  — gradient in
+      ("batch_barrier",)           — trainer finished sending this batch
+      ("get", var_name)            — parameter out (blocks until updated)
+      ("complete",)                — trainer shutting down
+    """
+
+    def __init__(self, pserver_program, startup_program, endpoint, fanin,
+                 scope=None):
+        import paddle_tpu.fluid as fluid
+
+        self.program = pserver_program
+        self.endpoint = endpoint
+        self.fanin = fanin
+        self.scope = scope if scope is not None else fluid.Scope()
+        self.exe = fluid.Executor(fluid.CPUPlace())
+        if startup_program is not None:
+            self.exe.run(startup_program, scope=self.scope)
+
+        lns = self.program.desc.global_block().ops[-1]
+        assert lns.type == "listen_and_serv"
+        self.optimize_blocks = list(lns.attrs["optimize_blocks"])
+
+        self._lock = threading.Condition()
+        self._grads = {}          # name -> list of arrays this batch
+        self._barriers = 0
+        self._updated_batch = 0   # generation counter
+        self._completed = 0
+        self._stop = False
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(16)
+        self._threads = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self):
+        accept_thread = threading.Thread(target=self._accept_loop,
+                                         daemon=True)
+        accept_thread.start()
+        with self._lock:
+            while not self._stop:
+                self._lock.wait(timeout=0.1)
+        self._sock.close()
+
+    def start(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- request handling --------------------------------------------------
+    def _handle(self, conn):
+        while True:
+            msg = _recv_msg(conn)
+            if msg is None:
+                conn.close()
+                return
+            kind = msg[0]
+            if kind == "send":
+                _, name, arr = msg
+                with self._lock:
+                    self._grads.setdefault(name, []).append(arr)
+                _send_msg(conn, ("ok",))
+            elif kind == "batch_barrier":
+                with self._lock:
+                    self._barriers += 1
+                    gen = self._updated_batch
+                    if self._barriers == self.fanin:
+                        self._run_update()
+                        self._barriers = 0
+                        self._updated_batch += 1
+                        self._lock.notify_all()
+                    else:
+                        while (self._updated_batch == gen
+                               and not self._stop):
+                            self._lock.wait(timeout=5)
+                _send_msg(conn, ("ok",))
+            elif kind == "get":
+                _, name = msg
+                val = self.scope.get(name)
+                _send_msg(conn, ("var", np.asarray(val)))
+            elif kind == "complete":
+                with self._lock:
+                    self._completed += 1
+                    if self._completed >= self.fanin:
+                        self._stop = True
+                        self._lock.notify_all()
+                _send_msg(conn, ("ok",))
+                conn.close()
+                return
+            else:
+                _send_msg(conn, ("error", "unknown request %r" % kind))
+
+    def _run_update(self):
+        """Average buffered grads, run each optimizer sub-block
+        (RunSyncLoop body, listen_and_serv_op.cc:150-160)."""
+        avg = {
+            name: np.mean(np.stack(vals), axis=0)
+            for name, vals in self._grads.items()
+        }
+        self._grads.clear()
+        for name, val in avg.items():
+            self.scope.set(name, val)
+        for bidx in self.optimize_blocks:
+            self.exe.engine.run_block(
+                self.program.desc, bidx, self.scope, feed={},
+                fetch_list=[])
+
+
+# -- client ----------------------------------------------------------------
+
+class PSClient:
+    """Trainer-side RPC client (reference: distributed/rpc_client.h:32 —
+    AsyncSendVar/AsyncGetVar + barriers, SendComplete)."""
+
+    def __init__(self, endpoints):
+        self._socks = {}
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=60)
+            self._socks[ep] = s
+
+    def send_var(self, ep, name, arr):
+        _send_msg(self._socks[ep], ("send", name, np.asarray(arr)))
+        assert _recv_msg(self._socks[ep])[0] == "ok"
+
+    def batch_barrier(self):
+        for s in self._socks.values():
+            _send_msg(s, ("batch_barrier",))
+        for s in self._socks.values():
+            assert _recv_msg(s)[0] == "ok"
+
+    def get_var(self, ep, name):
+        _send_msg(self._socks[ep], ("get", name))
+        kind, val = _recv_msg(self._socks[ep])
+        assert kind == "var"
+        return val
+
+    def send_complete(self):
+        for s in self._socks.values():
+            try:
+                _send_msg(s, ("complete",))
+                _recv_msg(s)
+            except OSError:
+                pass
+            s.close()
+
+
+class DistTrainer:
+    """Runs a transpiled trainer program: compiled fwd/bwd on the engine,
+    then send-grads → barrier → recv-params over the client (the role of
+    the send/recv/fetch_barrier ops in the reference trainer program)."""
+
+    def __init__(self, trainer_program, transpiler, scope=None):
+        import paddle_tpu.fluid as fluid
+
+        self.scope = scope if scope is not None else fluid.Scope()
+        self.exe = fluid.Executor()
+        # send/recv markers carry the routing; the compiled program runs
+        # without them (the transport is this class)
+        self._sends = []   # (grad_name, endpoint)
+        self._recvs = []   # (param_name, endpoint)
+        self.program = trainer_program.clone()
+        block = self.program.desc.global_block()
+        kept = []
+        for op in block.ops:
+            if op.type == "send":
+                self._sends.append(
+                    (op.inputs["X"][0], op.attrs["endpoints"][0]))
+            elif op.type == "recv":
+                self._recvs.append(
+                    (op.outputs["Out"][0], op.attrs["endpoints"][0]))
+            else:
+                kept.append(op)
+        block.ops = kept
+        self.program._bump_version()
+        eps = sorted({ep for _, ep in self._sends + self._recvs})
+        self.client = PSClient(eps)
+
+    def run_startup(self, startup_program):
+        self.exe.run(startup_program, scope=self.scope)
+
+    def pull_params(self):
+        """Initial sync so all trainers start from the pserver's params."""
+        for name, ep in self._recvs:
+            self.scope.set(name, self.client.get_var(ep, name))
+
+    def run(self, feed, fetch_list):
+        grad_names = [g for g, _ in self._sends]
+        outs = self.exe.run(
+            self.program, feed=feed,
+            fetch_list=list(fetch_list) + grad_names, scope=self.scope)
+        n_fetch = len(fetch_list)
+        grads = dict(zip(grad_names, outs[n_fetch:]))
+        for gname, ep in self._sends:
+            self.client.send_var(ep, gname, grads[gname])
+        self.client.batch_barrier()
+        for pname, ep in self._recvs:
+            self.scope.set(pname, self.client.get_var(ep, pname))
+        return outs[:n_fetch]
+
+    def close(self):
+        self.client.send_complete()

@@ -1,0 +1,115 @@
+"""Parameter-server distributed training test (the analog of the
+reference's TestDistBase: real localhost transport, 2 pservers + 2
+trainers, trainer losses compared against a local single-process run —
+tests/unittests/test_dist_base.py:213)."""
+
+import socket
+import threading
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed.ps import ParameterServer, DistTrainer
+from paddle_tpu.framework import Program, program_guard
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build(lr=0.1, seed=0):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, batch, seed):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(16, 4).astype(np.float32)
+    out = []
+    for _ in range(n):
+        xv = rng.randn(batch, 16).astype(np.float32)
+        yv = np.argmax(xv @ W, 1).astype(np.int64).reshape(-1, 1)
+        out.append({"x": xv, "y": yv})
+    return out
+
+
+def test_pserver_training_matches_local():
+    n_steps, full_batch = 8, 32
+    batches = _batches(n_steps, full_batch, seed=0)
+
+    # ---- local reference run --------------------------------------------
+    main, startup, loss = _build()
+    exe = fluid.Executor()
+    local_scope = fluid.Scope()
+    exe.run(startup, scope=local_scope)
+    init_vals = {
+        p.name: np.asarray(local_scope.get(p.name))
+        for p in main.all_parameters()
+    }
+    local_losses = []
+    for b in batches:
+        (l,) = exe.run(main, feed=b, fetch_list=[loss], scope=local_scope)
+        local_losses.append(float(l))
+
+    # ---- transpile -------------------------------------------------------
+    main2, startup2, loss2 = _build()
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main2, pservers=",".join(eps),
+                trainers=2, startup_program=startup2)
+    trainer_prog = t.get_trainer_program()
+
+    # ---- pservers (threads with real sockets) ---------------------------
+    servers = []
+    for ep in eps:
+        ps_prog = t.get_pserver_program(ep)
+        srv = ParameterServer(ps_prog, startup2, ep, fanin=2)
+        # identical start point as the local run
+        for name, val in init_vals.items():
+            srv.scope.set(name, val)
+        srv.start()
+        servers.append(srv)
+
+    # ---- trainers --------------------------------------------------------
+    half = full_batch // 2
+    results = [None, None]
+
+    def run_trainer(tid):
+        trainer = DistTrainer(trainer_prog, t)
+        trainer.run_startup(startup2)
+        trainer.pull_params()
+        losses = []
+        for b in batches:
+            sl = slice(tid * half, (tid + 1) * half)
+            feed = {"x": b["x"][sl], "y": b["y"][sl]}
+            (l,) = trainer.run(feed, [loss2.name])
+            losses.append(float(l))
+        trainer.close()
+        results[tid] = losses
+
+    threads = [threading.Thread(target=run_trainer, args=(i,))
+               for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert all(r is not None for r in results), "a trainer died"
+
+    # average of half-batch losses == full-batch loss; SGD on averaged
+    # grads == full-batch SGD, so trajectories must match tightly
+    dist_losses = [(a + b) / 2 for a, b in zip(*results)]
+    np.testing.assert_allclose(dist_losses, local_losses, rtol=1e-4,
+                               atol=1e-5)
+    assert dist_losses[-1] < dist_losses[0]
