@@ -15,7 +15,6 @@ trainers are averaged, then each parameter's optimizer sub-block runs on
 the XLA engine.
 """
 
-import pickle
 import socket
 import struct
 import threading
@@ -24,9 +23,97 @@ import numpy as np
 
 
 # -- framing ---------------------------------------------------------------
+#
+# Typed wire format — the analog of the reference's VariableMessage proto
+# (send_recv.proto.in): a message is a tuple of str / ndarray fields, each
+# self-describing. No pickle: nothing received from the socket is ever
+# interpreted as code, mirroring the reference's typed zero-copy serde
+# (grpc_serde.cc).
+#
+#   frame   := <Q total_len> payload
+#   payload := <B nfields> field*
+#   field   := 0x01 <I len> utf8-bytes                    (str)
+#            | 0x02 <B dlen> dtype-utf8 <B ndim> <Q>*ndim raw-bytes (ndarray)
+
+_TAG_STR = 1
+_TAG_ARR = 2
+
+_ALLOWED_DTYPES = frozenset([
+    "float16", "bfloat16", "float32", "float64",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool",
+])
+
+
+def _np_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16 et al. (ships with jax)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_msg(fields):
+    parts = [struct.pack("<B", len(fields))]
+    for f in fields:
+        if isinstance(f, str):
+            b = f.encode("utf-8")
+            parts.append(struct.pack("<BI", _TAG_STR, len(b)))
+            parts.append(b)
+        else:
+            arr = np.ascontiguousarray(f)
+            # Enforce the wire contract on the sending side too, so a bad
+            # call fails fast with a local traceback instead of a remote
+            # decode error.
+            if arr.dtype.name not in _ALLOWED_DTYPES:
+                raise TypeError(
+                    "cannot send field of type %s/dtype %s over the "
+                    "pserver wire" % (type(f).__name__, arr.dtype))
+            dt = arr.dtype.name.encode("utf-8")
+            parts.append(struct.pack("<BB", _TAG_ARR, len(dt)))
+            parts.append(dt)
+            parts.append(struct.pack("<B", arr.ndim))
+            parts.append(struct.pack("<%dQ" % arr.ndim, *arr.shape))
+            parts.append(arr.tobytes())
+    return b"".join(parts)
+
+
+def _decode_msg(body):
+    (nfields,) = struct.unpack_from("<B", body, 0)
+    off = 1
+    fields = []
+    for _ in range(nfields):
+        (tag,) = struct.unpack_from("<B", body, off)
+        off += 1
+        if tag == _TAG_STR:
+            (n,) = struct.unpack_from("<I", body, off)
+            off += 4
+            fields.append(body[off:off + n].decode("utf-8"))
+            off += n
+        elif tag == _TAG_ARR:
+            (dlen,) = struct.unpack_from("<B", body, off)
+            off += 1
+            dtype = body[off:off + dlen].decode("ascii")
+            off += dlen
+            if dtype not in _ALLOWED_DTYPES:
+                raise ValueError("disallowed dtype on wire: %r" % dtype)
+            (ndim,) = struct.unpack_from("<B", body, off)
+            off += 1
+            shape = struct.unpack_from("<%dQ" % ndim, body, off)
+            off += 8 * ndim
+            dt = _np_dtype(dtype)
+            n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            arr = np.frombuffer(body[off:off + n], dtype=dt).reshape(shape)
+            off += n
+            fields.append(arr)
+        else:
+            raise ValueError("bad wire tag %d" % tag)
+    return tuple(fields)
+
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=4)
+    payload = _encode_msg(obj)
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -38,7 +125,7 @@ def _recv_msg(sock):
     body = _recv_exact(sock, n)
     if body is None:
         return None
-    return pickle.loads(body)
+    return _decode_msg(body)
 
 
 def _recv_exact(sock, n):
@@ -120,10 +207,23 @@ class ParameterServer:
 
     # -- request handling --------------------------------------------------
     def _handle(self, conn):
+        try:
+            self._handle_loop(conn)
+        except (ValueError, TypeError, struct.error) as e:
+            # Malformed frame (bad tag / disallowed dtype / truncation):
+            # reply with an error if the socket still works, then close so
+            # the peer sees EOF instead of blocking until its timeout.
+            try:
+                _send_msg(conn, ("error", "protocol error: %s" % e))
+            except OSError:
+                pass
+        finally:
+            conn.close()
+
+    def _handle_loop(self, conn):
         while True:
             msg = _recv_msg(conn)
             if msg is None:
-                conn.close()
                 return
             kind = msg[0]
             if kind == "send":

@@ -101,10 +101,19 @@ class BlockingQueue:
     def push(self, data: bytes) -> bool:
         if self._native is not None:
             return self._native.btq_push(self._q, data, len(data)) == 0
-        if self._closed:
-            return False
-        self._q.put(data)
-        return True
+        import queue
+
+        # Re-check _closed between bounded put attempts so close() can
+        # unblock a producer stuck on a full queue (mirrors the native
+        # btq_push close semantics; a plain blocking put would hang the
+        # producer thread forever if the consumer stops early).
+        while not self._closed:
+            try:
+                self._q.put(data, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def pop(self):
         """bytes, or None at end-of-stream."""

@@ -287,25 +287,28 @@ class Adam(Optimizer):
         )
 
     def _finish_update(self, block, parameters_and_grads):
-        """Advance beta powers once per step
-        (reference: optimizer.py Adam._finish_update)."""
+        """Advance beta powers once per step, under _optimized_guard so the
+        scale ops carry op_role_var and the DistributeTranspiler routes them
+        to the owning pserver (reference: optimizer.py:855 Adam
+        _finish_update wraps these in _optimized_guard([param, grad]))."""
         for param, grad in parameters_and_grads:
             if grad is None:
                 continue
             b1p = self._get_accumulator("beta1_pow_acc", param)
             b2p = self._get_accumulator("beta2_pow_acc", param)
-            block.append_op(
-                type="scale",
-                inputs={"X": [b1p]},
-                outputs={"Out": [b1p]},
-                attrs={"scale": self._beta1},
-            )
-            block.append_op(
-                type="scale",
-                inputs={"X": [b2p]},
-                outputs={"Out": [b2p]},
-                attrs={"scale": self._beta2},
-            )
+            with block.program._optimized_guard((param, grad)):
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [b1p]},
+                    outputs={"Out": [b1p]},
+                    attrs={"scale": self._beta1},
+                )
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [b2p]},
+                    outputs={"Out": [b2p]},
+                    attrs={"scale": self._beta2},
+                )
 
 
 class Adamax(Optimizer):
@@ -355,12 +358,13 @@ class Adamax(Optimizer):
             if grad is None:
                 continue
             b1p = self._get_accumulator("beta1_pow_acc", param)
-            block.append_op(
-                type="scale",
-                inputs={"X": [b1p]},
-                outputs={"Out": [b1p]},
-                attrs={"scale": self._beta1},
-            )
+            with block.program._optimized_guard((param, grad)):
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [b1p]},
+                    outputs={"Out": [b1p]},
+                    attrs={"scale": self._beta1},
+                )
 
 
 class DecayedAdagrad(Optimizer):

@@ -42,20 +42,28 @@ def reference_attention(q, k, v, causal=False, scale=None):
 
 
 def _ring_body(q_blk, k_blk, v_blk, axis_name, n_shards, causal, scale):
-    """Per-device body under shard_map. Blocks are [B, H, t, D] locals."""
+    """Per-device body under shard_map. Blocks are [B, H, t, D] locals.
+
+    The online-softmax carry (o/m/l) accumulates in float32 regardless of
+    input dtype — with bf16 inputs a bf16 running max/denominator loses
+    the flash-kernel's accuracy and ``_NEG`` rounds to -inf; the output is
+    cast back at the end (same discipline as kernels/flash_attention.py).
+    """
+    in_dtype = q_blk.dtype
     idx = lax.axis_index(axis_name)
     t = q_blk.shape[2]
     q_pos = idx * t + jnp.arange(t)  # global positions of local queries
 
-    o0 = jnp.zeros_like(q_blk)
-    m0 = jnp.full(q_blk.shape[:3], _NEG, q_blk.dtype)   # running max
-    l0 = jnp.zeros(q_blk.shape[:3], q_blk.dtype)        # running denom
+    o0 = jnp.zeros(q_blk.shape, jnp.float32)
+    m0 = jnp.full(q_blk.shape[:3], _NEG, jnp.float32)   # running max
+    l0 = jnp.zeros(q_blk.shape[:3], jnp.float32)        # running denom
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
     def step(carry, i):
         o, m, l, k_cur, v_cur = carry
         src = (idx - i) % n_shards  # whose K/V block we hold this step
-        s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_cur) * scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_blk.astype(jnp.float32),
+                       k_cur.astype(jnp.float32)) * scale
         if causal:
             k_pos = src * t + jnp.arange(t)
             keep = q_pos[:, None] >= k_pos[None, :]
@@ -64,14 +72,16 @@ def _ring_body(q_blk, k_blk, v_blk, axis_name, n_shards, causal, scale):
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (o, m_new, l, k_nxt, v_nxt), None
 
     (o, m, l, _, _), _ = lax.scan(
         step, (o0, m0, l0, k_blk, v_blk), jnp.arange(n_shards))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(in_dtype)
 
 
 def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,

@@ -22,13 +22,28 @@ class InferenceTranspiler:
         bias (reference: inference_transpiler.py fuse_batch_norm)."""
         block = program.desc.global_block()
         ops = block.ops
-        to_drop = []
         for i in range(len(ops) - 1):
             conv, bn = ops[i], ops[i + 1]
             if conv.type != "conv2d" or bn.type != "batch_norm":
                 continue
             if conv.outputs.get("Output", [None])[0] != \
                     bn.inputs.get("X", [None])[0]:
+                continue
+            # Folding is only sound when: BN runs with frozen statistics
+            # (test mode), the conv output feeds ONLY this BN (otherwise
+            # other consumers would see rescaled activations), and
+            # groups==1 (grouped conv filters don't map 1:1 onto output
+            # channels for the per-channel rescale below).
+            if not bn.attrs.get("is_test", False):
+                continue
+            if int(conv.attrs.get("groups", 1)) != 1:
+                continue
+            conv_out_name = conv.outputs["Output"][0]
+            consumers = sum(
+                1 for op in ops
+                for names in op.inputs.values()
+                for n in names if n == conv_out_name)
+            if consumers != 1:
                 continue
             w_name = conv.inputs["Filter"][0]
             w = np.asarray(scope.get(w_name))
@@ -43,7 +58,11 @@ class InferenceTranspiler:
             scope.set(w_name, w * scale.reshape(-1, 1, 1, 1))
             bias_fold = (beta - gamma * mean * inv_std).astype(w.dtype)
 
-            # rewire: conv writes BN's output var, then an elementwise bias
+            # Rewire: conv now writes a fresh intermediate var (its
+            # activations are rescaled, so the original output name must
+            # NOT keep existing with changed values — a fetch of it fails
+            # loudly instead of silently returning rescaled data), then an
+            # elementwise bias produces BN's output.
             bn_out = bn.outputs["Y"][0]
             bias_name = w_name + ".bn_bias"
             from paddle_tpu.core.desc import OpDesc, VarDescData
@@ -53,10 +72,16 @@ class InferenceTranspiler:
                     bias_name, shape=[int(bias_fold.shape[0])],
                     dtype="float32", persistable=True)
             scope.set(bias_name, bias_fold)
-            conv_out = conv.outputs["Output"][0]
+            folded_out = conv_out_name + ".bnfold"
+            if folded_out not in block.vars and conv_out_name in block.vars:
+                src = block.vars[conv_out_name]
+                block.vars[folded_out] = VarDescData(
+                    folded_out, shape=list(src.shape or []),
+                    dtype=src.dtype, persistable=False)
+            conv.outputs["Output"] = [folded_out]
             ops[i + 1] = OpDesc(
                 "elementwise_add",
-                inputs={"X": [conv_out], "Y": [bias_name]},
+                inputs={"X": [folded_out], "Y": [bias_name]},
                 outputs={"Out": [bn_out]},
                 attrs={"axis": 1},
             )

@@ -21,7 +21,7 @@ def _free_port():
     return port
 
 
-def _build(lr=0.1, seed=0):
+def _build(lr=0.1, seed=0, optimizer="sgd"):
     main, startup = Program(), Program()
     with program_guard(main, startup):
         x = fluid.layers.data(name="x", shape=[16], dtype="float32")
@@ -30,7 +30,10 @@ def _build(lr=0.1, seed=0):
         pred = fluid.layers.fc(input=h, size=4)
         loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
             logits=pred, label=y))
-        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        if optimizer == "adam":
+            fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+        else:
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
     return main, startup, loss
 
 
@@ -46,11 +49,23 @@ def _batches(n, batch, seed):
 
 
 def test_pserver_training_matches_local():
+    _run_pserver_vs_local("sgd")
+
+
+def test_pserver_adam_matches_local():
+    """Adam on the pserver must advance beta1/beta2 power accumulators —
+    their scale ops carry op_role_var via _optimized_guard so the
+    transpiler routes them to the owning server (reference:
+    optimizer.py:855)."""
+    _run_pserver_vs_local("adam", lr=0.01)
+
+
+def _run_pserver_vs_local(optimizer, lr=0.1):
     n_steps, full_batch = 8, 32
     batches = _batches(n_steps, full_batch, seed=0)
 
     # ---- local reference run --------------------------------------------
-    main, startup, loss = _build()
+    main, startup, loss = _build(lr=lr, optimizer=optimizer)
     exe = fluid.Executor()
     local_scope = fluid.Scope()
     exe.run(startup, scope=local_scope)
@@ -64,7 +79,7 @@ def test_pserver_training_matches_local():
         local_losses.append(float(l))
 
     # ---- transpile -------------------------------------------------------
-    main2, startup2, loss2 = _build()
+    main2, startup2, loss2 = _build(lr=lr, optimizer=optimizer)
     eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
     t = fluid.DistributeTranspiler()
     t.transpile(trainer_id=0, program=main2, pservers=",".join(eps),
