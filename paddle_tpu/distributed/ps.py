@@ -232,19 +232,33 @@ class ParameterServer:
                     self._grads.setdefault(name, []).append(arr)
                 _send_msg(conn, ("ok",))
             elif kind == "batch_barrier":
+                failed = False
                 with self._lock:
                     self._barriers += 1
                     gen = self._updated_batch
                     if self._barriers == self.fanin:
-                        self._run_update()
+                        try:
+                            self._run_update()
+                            self._updated_batch += 1
+                        except Exception:
+                            # An update failure while peers are parked in
+                            # the wait loop below must not leave the
+                            # barrier stuck at fanin — stop the server so
+                            # every trainer unblocks; the un-bumped
+                            # generation tells them it failed.
+                            self._stop = True
+                            failed = True
                         self._barriers = 0
-                        self._updated_batch += 1
                         self._lock.notify_all()
                     else:
                         while (self._updated_batch == gen
                                and not self._stop):
                             self._lock.wait(timeout=5)
-                _send_msg(conn, ("ok",))
+                        failed = self._stop and self._updated_batch == gen
+                if failed:
+                    _send_msg(conn, ("error", "parameter update failed"))
+                else:
+                    _send_msg(conn, ("ok",))
             elif kind == "get":
                 _, name = msg
                 val = self.scope.get(name)

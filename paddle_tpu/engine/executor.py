@@ -98,15 +98,22 @@ class Engine:
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
 
         self._run_counter += 1
-        rng_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
+        # The PRNG key is derived INSIDE the jitted function from two scalar
+        # operands — eager ops (PRNGKey/fold_in) cost a full dispatch round
+        # trip per step on remote-tunneled platforms (measured ~140 ms/step,
+        # the round-1 MNIST bottleneck).
+        rng_seed = (np.uint32(seed), np.uint32(self._run_counter))
 
-        fetches, state_out = compiled.jitted(feed_values, mutated, readonly, rng_key)
+        fetches, state_out = compiled.jitted(feed_values, mutated, readonly,
+                                             rng_seed)
 
         for name, val in zip(compiled.block_program.state_out_names, state_out):
             scope.set(name, val)
 
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            # one batched host transfer for all fetches (device_get on the
+            # list) — per-value np.asarray syncs serially
+            return list(jax.device_get(list(fetches)))
         return list(fetches)
 
     @staticmethod
@@ -133,7 +140,9 @@ class Engine:
         mutated_idx = {n: i for i, n in enumerate(mutated)}
         readonly_idx = {n: i for i, n in enumerate(readonly)}
 
-        def wrapped(feed_values, mutated_vals, readonly_vals, rng_key):
+        def wrapped(feed_values, mutated_vals, readonly_vals, rng_seed):
+            seed, ctr = rng_seed
+            rng_key = jax.random.fold_in(jax.random.PRNGKey(seed), ctr)
             state_values = [
                 mutated_vals[mutated_idx[n]]
                 if n in mutated_idx

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
-from paddle_tpu.ops.common import single
+from paddle_tpu.ops.common import fp32_accum, single
 
 
 def _unary(fn):
@@ -129,11 +129,14 @@ def thresholded_relu(ctx, ins, attrs):
 def softmax(ctx, ins, attrs):
     x = single(ins, "X")
     axis = attrs.get("axis", -1)
-    return {"Out": [jax.nn.softmax(x, axis=axis)]}
+    # fp32 internal exp/sum for low-precision inputs, result cast back
+    return {"Out": [jax.nn.softmax(fp32_accum(x), axis=axis)
+                    .astype(x.dtype)]}
 
 
 @register_op("log_softmax")
 def log_softmax(ctx, ins, attrs):
     x = single(ins, "X")
     axis = attrs.get("axis", -1)
-    return {"Out": [jax.nn.log_softmax(x, axis=axis)]}
+    return {"Out": [jax.nn.log_softmax(fp32_accum(x), axis=axis)
+                    .astype(x.dtype)]}

@@ -5,6 +5,17 @@ import jax.numpy as jnp
 from paddle_tpu.core.registry import amp_enabled
 
 
+def fp32_accum(x):
+    """The AMP numerics policy for accumulation-sensitive internals
+    (norm statistics, softmax/log-sum-exp, losses, large mean-pools):
+    low-precision floats (bf16, f16) upcast to fp32 for the internal
+    compute; callers cast the result back to the activation dtype so no
+    extra HBM traffic crosses op boundaries."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return x.astype(jnp.float32)
+    return x
+
+
 def amp_cast(*xs):
     """Under AMP, cast float32 operands to bfloat16 (compute dtype); pair
     with preferred_element_type=float32 so accumulation stays fp32."""

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
-from paddle_tpu.ops.common import single
+from paddle_tpu.ops.common import fp32_accum, single
 
 
 def _squeeze_label(label):
@@ -35,6 +35,9 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     label = single(ins, "Label")
     soft = attrs.get("soft_label", False)
     ignore_index = attrs.get("ignore_index", -100)
+    # Losses always compute in fp32: low-precision logits (AMP keeps
+    # activations bf16 end-to-end) lose too much in the log-sum-exp.
+    logits = fp32_accum(logits)
     log_sm = jax.nn.log_softmax(logits, axis=-1)
     softmax_out = jnp.exp(log_sm)
     if soft:

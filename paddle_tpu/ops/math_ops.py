@@ -23,7 +23,12 @@ def mul(ctx, ins, attrs):
     x2 = flatten_to_2d(x, xnc)
     y2 = flatten_to_2d(y, ync)
     x2, y2 = amp_cast(x2, y2)
-    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32)
+    # bf16 operands → bf16 output (MXU still accumulates fp32 internally);
+    # an fp32 output would force every downstream elementwise op to fp32
+    # HBM traffic. fp32 keeps explicit fp32 accumulation, and f16 — whose
+    # narrow exponent overflows on long dots — still accumulates to fp32.
+    pet = None if x2.dtype == jnp.bfloat16 else jnp.float32
+    out = jnp.matmul(x2, y2, preferred_element_type=pet)
     out_shape = x.shape[:xnc] + y.shape[ync:]
     return {"Out": [out.reshape(out_shape)]}
 
@@ -40,8 +45,13 @@ def matmul(ctx, ins, attrs):
     if ty:
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     x, y = amp_cast(x, y)
-    pet = jnp.float32 if jnp.issubdtype(
-        jnp.result_type(x, y), jnp.floating) else None
+    rt = jnp.result_type(x, y)
+    if rt == jnp.bfloat16:
+        pet = None  # bf16 out; MXU accumulates fp32 internally
+    elif jnp.issubdtype(rt, jnp.floating):
+        pet = jnp.float32  # incl. f16: narrow exponent overflows long dots
+    else:
+        pet = None
     out = jnp.matmul(x, y, preferred_element_type=pet)
     if alpha != 1.0:
         out = out * alpha
@@ -53,6 +63,13 @@ def _elementwise(fn):
         x = single(ins, "X")
         y = single(ins, "Y")
         y = bcast_y_to_x(x, y, attrs.get("axis", -1))
+        # bf16 activation ⊕ fp32 param (e.g. a bias add after a bf16
+        # matmul): compute in bf16 instead of letting promotion drag the
+        # whole activation tensor to fp32 — the cast's vjp still delivers
+        # an fp32 gradient to the param.
+        if (hasattr(x, "dtype") and hasattr(y, "dtype")
+                and x.dtype == jnp.bfloat16 and y.dtype == jnp.float32):
+            y = y.astype(jnp.bfloat16)
         return {"Out": [fn(x, y)]}
 
     return lower

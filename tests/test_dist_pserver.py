@@ -60,6 +60,41 @@ def test_pserver_adam_matches_local():
     _run_pserver_vs_local("adam", lr=0.01)
 
 
+def test_pserver_update_failure_unblocks_trainers():
+    """A failing optimizer update must reply with an error instead of
+    leaving the batch barrier stuck at fanin (the silent-hang case: one
+    trainer's bad gradient shape used to deadlock every peer in the
+    generation wait loop)."""
+    from paddle_tpu.distributed.ps import PSClient
+
+    main, startup, loss = _build()
+    ep = "127.0.0.1:%d" % _free_port()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                startup_program=startup)
+    srv = ParameterServer(t.get_pserver_program(ep), startup, ep, fanin=1)
+    srv.start()
+    try:
+        client = PSClient([ep])
+        gname = None
+        for op in t.get_trainer_program().desc.global_block().ops:
+            if op.type == "send":
+                gname = op.inputs["X"][0]
+                break
+        assert gname is not None
+        # wrong shape: the optimizer sub-block will fail
+        client.send_var(ep, gname, np.zeros((3, 3), np.float32))
+        from paddle_tpu.distributed.ps import _send_msg, _recv_msg
+        sock = client._socks[ep]
+        _send_msg(sock, ("batch_barrier",))
+        reply = _recv_msg(sock)
+        assert reply is not None and reply[0] == "error"
+    finally:
+        with srv._lock:
+            srv._stop = True
+            srv._lock.notify_all()
+
+
 def _run_pserver_vs_local(optimizer, lr=0.1):
     n_steps, full_batch = 8, 32
     batches = _batches(n_steps, full_batch, seed=0)
