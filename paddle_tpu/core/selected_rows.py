@@ -1,0 +1,92 @@
+"""SelectedRows: sparse row-slice gradients as a traced pytree value.
+
+TPU-native equivalent of the reference's SelectedRows variable type
+(reference: paddle/fluid/framework/selected_rows.h:32) and its sparse
+kernels (reference: paddle/fluid/operators/math/selected_rows_functor.cc).
+Where the reference makes SelectedRows a runtime Variable type dispatched
+per-kernel, here it is a pytree value that flows through the traced block:
+``lookup_table_grad`` emits it, ``sum``/clip ops combine it, and the
+optimizer lowerings consume it with row-wise scatter updates. Shapes stay
+static (rows is always [N] for a batch of N ids), so XLA compiles one
+executable regardless of which rows are touched.
+
+Deduplication (the reference's scatter::MergeAdd) is done with a
+fixed-size ``jnp.unique`` whose padding slots use ``height`` as an
+out-of-range sentinel row; XLA scatter drops out-of-bounds indices, so
+sentinel rows are no-ops in every downstream update.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int32 [N]; values: [N, *dims]; height: static table height."""
+
+    def __init__(self, rows, values, height, merged=False):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+        # True when rows are known-unique (or sentinel); lets consumers
+        # skip a redundant merge.
+        self.is_merged = bool(merged)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), (self.height, self.is_merged)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], merged=aux[1])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def ndim(self):
+        return self.values.ndim
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height,
+                            merged=self.is_merged)
+
+    def map_values(self, fn):
+        """Apply a row-wise linear/elementwise fn to the values (valid for
+        sparsity-preserving transforms like scaling)."""
+        return SelectedRows(self.rows, fn(self.values), self.height,
+                            merged=self.is_merged)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self):
+        """Deduplicate rows (reference: scatter::MergeAdd): duplicate rows'
+        values are summed; padding slots get sentinel row == height and zero
+        values. Static shapes throughout."""
+        if self.is_merged:
+            return self
+        n = self.rows.shape[0]
+        uniq = jnp.unique(self.rows, size=n, fill_value=self.height)
+        idx = jnp.searchsorted(uniq, self.rows)
+        vals = jnp.zeros_like(self.values).at[idx].add(self.values)
+        return SelectedRows(uniq, vals, self.height, merged=True)
+
+
+def is_selected_rows(x):
+    return isinstance(x, SelectedRows)
+
+
+def densify(x):
+    """Dense view of x whether sparse or already dense."""
+    return x.to_dense() if isinstance(x, SelectedRows) else x
+
+
+def add_to_dense(dense, sr):
+    """dense + sr without materializing sr densely."""
+    return dense.at[sr.rows].add(sr.values.astype(dense.dtype), mode="drop")

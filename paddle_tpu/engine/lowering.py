@@ -162,8 +162,14 @@ def lower_block(block_program, is_test=False, executor=None, amp=False):
             for op_index, op in enumerate(block_program.ops):
                 run_op(op, block, env, rng_key, op_index, is_test, executor)
 
-        fetches = [env[n] for n in block_program.fetch_names]
-        state_out = [env[n] for n in block_program.state_out_names]
+        # SelectedRows sparse grads are an intra-block representation;
+        # anything crossing the jit boundary (user fetches, persisted
+        # state) is densified, like the reference's GetFetchVariable
+        # materializing SelectedRows into a tensor.
+        from paddle_tpu.core.selected_rows import densify
+
+        fetches = [densify(env[n]) for n in block_program.fetch_names]
+        state_out = [densify(env[n]) for n in block_program.state_out_names]
         return fetches, state_out
 
     return fn

@@ -134,8 +134,12 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32"):
     """Embedding lookup (reference: layers/nn.py:302). ``is_sparse`` selects
-    sparse (SelectedRows-equivalent) gradients — on TPU dense scatter-add
-    gradients are used; the flag is accepted for compatibility."""
+    sparse SelectedRows gradients: ``lookup_table_grad`` emits a
+    (rows, values) pytree and the optimizer applies row-wise scatter
+    updates — no table-sized gradient is materialized (see
+    core/selected_rows.py). ``is_distributed`` additionally shards the
+    table across parameter servers via the distribute transpiler's
+    lookup-table path."""
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(
         attr=param_attr, shape=size, dtype=dtype, is_bias=False

@@ -65,7 +65,13 @@ def square_error_cost(ctx, ins, attrs):
 
 @register_op("squared_l2_norm")
 def squared_l2_norm(ctx, ins, attrs):
+    from paddle_tpu.core.selected_rows import SelectedRows
+
     x = single(ins, "X")
+    if isinstance(x, SelectedRows):
+        # Norm of the dense view: merge duplicates first (padding rows are
+        # zero-valued so they do not contribute).
+        x = x.merged().values
     return {"Out": [jnp.sum(jnp.square(x)).reshape(1)]}
 
 

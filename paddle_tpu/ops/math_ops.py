@@ -60,14 +60,31 @@ def matmul(ctx, ins, attrs):
 
 def _elementwise(fn):
     def lower(ctx, ins, attrs):
+        from paddle_tpu.core.selected_rows import SelectedRows
+
         x = single(ins, "X")
         y = single(ins, "Y")
+        if isinstance(x, SelectedRows):
+            # Sparse grad ⊕ scalar (e.g. the global-norm clip's div by the
+            # clipped norm): sparsity-preserving for mul/div, which is all
+            # the grad machinery emits; other pairings densify.
+            if (fn in (jnp.multiply, jnp.divide)
+                    and not isinstance(y, SelectedRows)
+                    and jnp.size(y) == 1):
+                ys = jnp.asarray(y).reshape(())
+                return {"Out": [x.map_values(lambda v: fn(v, ys))]}
+            x = x.to_dense()
+        if isinstance(y, SelectedRows):
+            y = y.to_dense()
         y = bcast_y_to_x(x, y, attrs.get("axis", -1))
         # bf16 activation ⊕ fp32 param (e.g. a bias add after a bf16
         # matmul): compute in bf16 instead of letting promotion drag the
         # whole activation tensor to fp32 — the cast's vjp still delivers
-        # an fp32 gradient to the param.
-        if (hasattr(x, "dtype") and hasattr(y, "dtype")
+        # an fp32 gradient to the param. AMP-only: non-AMP programs that
+        # mix dtypes explicitly keep JAX's fp32 promotion semantics.
+        from paddle_tpu.core.registry import amp_enabled
+
+        if (amp_enabled() and hasattr(x, "dtype") and hasattr(y, "dtype")
                 and x.dtype == jnp.bfloat16 and y.dtype == jnp.float32):
             y = y.astype(jnp.bfloat16)
         return {"Out": [fn(x, y)]}
@@ -88,9 +105,17 @@ register_op("elementwise_floordiv", grad=None)(_elementwise(jnp.floor_divide))
 
 @register_op("scale")
 def scale(ctx, ins, attrs):
+    from paddle_tpu.core.selected_rows import SelectedRows
+
     x = single(ins, "X")
     s = attrs.get("scale", 1.0)
     bias = attrs.get("bias", 0.0)
+    if isinstance(x, SelectedRows):
+        # bias=0 preserves sparsity (scale_op SelectedRows kernel,
+        # reference: scale_op.h); a nonzero bias forces densification.
+        if bias == 0.0:
+            return {"Out": [x.map_values(lambda v: v * s)]}
+        x = x.to_dense()
     bias_after = attrs.get("bias_after_scale", True)
     if bias_after:
         out = x * s + bias
@@ -101,10 +126,24 @@ def scale(ctx, ins, attrs):
 
 @register_op("sum")
 def sum_op(ctx, ins, attrs):
+    """Elementwise sum; mixed dense/SelectedRows inputs follow the
+    reference's sum_op SelectedRows semantics (reference: sum_op.cc +
+    math/selected_rows_functor.cc): all-sparse stays sparse (row concat),
+    any dense input densifies the result via scatter-add."""
+    from paddle_tpu.core.selected_rows import SelectedRows, add_to_dense
+
     xs = ins.get("X", [])
-    out = xs[0]
-    for x in xs[1:]:
+    sparse = [x for x in xs if isinstance(x, SelectedRows)]
+    dense = [x for x in xs if not isinstance(x, SelectedRows)]
+    if sparse and not dense:
+        rows = jnp.concatenate([s.rows for s in sparse])
+        vals = jnp.concatenate([s.values for s in sparse])
+        return {"Out": [SelectedRows(rows, vals, sparse[0].height)]}
+    out = dense[0]
+    for x in dense[1:]:
         out = out + x
+    for s in sparse:
+        out = add_to_dense(out, s)
     return {"Out": [out]}
 
 
@@ -116,14 +155,31 @@ def pow_op(ctx, ins, attrs):
 
 @register_op("clip")
 def clip(ctx, ins, attrs):
+    from paddle_tpu.core.selected_rows import SelectedRows
+
     x = single(ins, "X")
-    return {"Out": [jnp.clip(x, attrs.get("min"), attrs.get("max"))]}
+    lo, hi = attrs.get("min"), attrs.get("max")
+    if isinstance(x, SelectedRows):
+        # Clip is per-element on the *dense* view, so duplicates must be
+        # merged first; sentinel/padding rows hold zeros, which stay zero
+        # only if the clip range brackets 0 — grad clipping always does.
+        m = x.merged()
+        return {"Out": [m.map_values(lambda v: jnp.clip(v, lo, hi))]}
+    return {"Out": [jnp.clip(x, lo, hi)]}
 
 
 @register_op("clip_by_norm")
 def clip_by_norm(ctx, ins, attrs):
+    from paddle_tpu.core.selected_rows import SelectedRows
+
     x = single(ins, "X")
     max_norm = attrs.get("max_norm")
+    if isinstance(x, SelectedRows):
+        m = x.merged()
+        norm = jnp.sqrt(jnp.sum(m.values * m.values))
+        scale_ = jnp.where(norm > max_norm,
+                           max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return {"Out": [m.map_values(lambda v: v * scale_)]}
     norm = jnp.sqrt(jnp.sum(x * x))
     out = jnp.where(norm > max_norm, x * (max_norm / jnp.maximum(norm, 1e-12)), x)
     return {"Out": [out]}

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.registry import register_op, register_no_grad_op
 from paddle_tpu.ops.common import amp_cast, fp32_accum, single
 
 
@@ -293,6 +293,31 @@ def lookup_table(ctx, ins, attrs):
         pad_mask = (flat_ids == padding_idx)[..., None]
         out = jnp.where(pad_mask, 0.0, out)
     return {"Out": [out]}
+
+
+@register_no_grad_op("lookup_table_grad")
+def lookup_table_grad(ctx, ins, attrs):
+    """Explicit table gradient (reference: lookup_table_op.cc grad kernel +
+    selected_rows path, framework/selected_rows.h:32). With is_sparse=True
+    the gradient is a SelectedRows value (rows = the batch's ids, values =
+    the incoming output grads) — no table-sized tensor is ever built; the
+    optimizer lowerings consume it with row-wise scatter updates."""
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    w = single(ins, "W")
+    ids = single(ins, "Ids")
+    og = single(ins, "Out@GRAD")
+    padding_idx = attrs.get("padding_idx", -1)
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    flat_ids = jnp.squeeze(ids, axis=-1) if squeeze_last else ids
+    rows = flat_ids.reshape(-1).astype(jnp.int32)
+    vals = og.reshape((rows.shape[0],) + tuple(w.shape[1:])).astype(w.dtype)
+    if padding_idx is not None and padding_idx >= 0:
+        vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+    if attrs.get("is_sparse", False):
+        return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
+    dense = jnp.zeros_like(w).at[rows].add(vals)
+    return {"W@GRAD": [dense]}
 
 
 @register_op("lrn")

@@ -7,6 +7,7 @@ the XLA equivalent of the reference's in-place kernels."""
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_no_grad_op
+from paddle_tpu.core.selected_rows import SelectedRows, densify
 from paddle_tpu.ops.common import single
 
 
@@ -14,8 +15,14 @@ from paddle_tpu.ops.common import single
 def sgd(ctx, ins, attrs):
     p = single(ins, "Param")
     g = single(ins, "Grad")
-    lr = single(ins, "LearningRate")
-    return {"ParamOut": [p - lr.reshape(()) * g]}
+    lr = single(ins, "LearningRate").reshape(())
+    if isinstance(g, SelectedRows):
+        # Sparse SGD (reference: optimizers/sgd_op.cc SelectedRows kernel):
+        # scatter-add directly — duplicates sum, which is exactly dense
+        # semantics since the update is linear in the gradient.
+        p_out = p.at[g.rows].add(-lr * g.values.astype(p.dtype), mode="drop")
+        return {"ParamOut": [p_out]}
+    return {"ParamOut": [p - lr * g]}
 
 
 @register_no_grad_op(
@@ -28,6 +35,17 @@ def momentum(ctx, ins, attrs):
     lr = single(ins, "LearningRate").reshape(())
     mu = attrs.get("mu")
     use_nesterov = attrs.get("use_nesterov", False)
+    if isinstance(g, SelectedRows):
+        # Exact dense semantics without a dense grad: the velocity decay
+        # touches every row, but the gradient enters linearly, so
+        # scatter-add suffices (no merge needed).
+        gv = g.values.astype(p.dtype)
+        v_out = (mu * v).at[g.rows].add(gv, mode="drop")
+        if use_nesterov:
+            p_out = (p - lr * mu * v_out).at[g.rows].add(-lr * gv, mode="drop")
+        else:
+            p_out = p - lr * v_out
+        return {"ParamOut": [p_out], "VelocityOut": [v_out]}
     v_out = mu * v + g
     if use_nesterov:
         p_out = p - (g + mu * v_out) * lr
@@ -41,7 +59,7 @@ def momentum(ctx, ins, attrs):
 )
 def lars_momentum(ctx, ins, attrs):
     p = single(ins, "Param")
-    g = single(ins, "Grad")
+    g = densify(single(ins, "Grad"))
     v = single(ins, "Velocity")
     lr = single(ins, "LearningRate").reshape(())
     mu = attrs.get("mu")
@@ -78,9 +96,23 @@ def adam(ctx, ins, attrs):
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    if isinstance(g, SelectedRows):
+        # Sparse ("lazy") Adam: only rows present in the gradient update
+        # their moments and param, matching the reference's SparseAdamFunctor
+        # row loop (reference: operators/optimizers/adam_op.h) — untouched
+        # rows keep stale moments rather than decaying every step.
+        m = g.merged()
+        rows, vals = m.rows, m.values.astype(p.dtype)
+        m1r = b1 * m1[rows] + (1.0 - b1) * vals
+        m2r = b2 * m2[rows] + (1.0 - b2) * jnp.square(vals)
+        m1o = m1.at[rows].set(m1r, mode="drop")
+        m2o = m2.at[rows].set(m2r, mode="drop")
+        p_out = p.at[rows].add(-lr_t * m1r / (jnp.sqrt(m2r) + eps),
+                               mode="drop")
+        return {"ParamOut": [p_out], "Moment1Out": [m1o], "Moment2Out": [m2o]}
     m1o = b1 * m1 + (1.0 - b1) * g
     m2o = b2 * m2 + (1.0 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
     p_out = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
     return {"ParamOut": [p_out], "Moment1Out": [m1o], "Moment2Out": [m2o]}
 
@@ -95,7 +127,7 @@ def adam(ctx, ins, attrs):
 )
 def adamax(ctx, ins, attrs):
     p = single(ins, "Param")
-    g = single(ins, "Grad")
+    g = densify(single(ins, "Grad"))
     m = single(ins, "Moment")
     inf = single(ins, "InfNorm")
     lr = single(ins, "LearningRate").reshape(())
@@ -119,6 +151,15 @@ def adagrad(ctx, ins, attrs):
     m = single(ins, "Moment")
     lr = single(ins, "LearningRate").reshape(())
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        # Sparse Adagrad is *exactly* dense Adagrad: zero-grad rows change
+        # neither moment nor param (reference: adagrad_op.cc sparse kernel).
+        sr = g.merged()
+        rows, vals = sr.rows, sr.values.astype(p.dtype)
+        mr = m[rows] + jnp.square(vals)
+        m_out = m.at[rows].set(mr, mode="drop")
+        p_out = p.at[rows].add(-lr * vals / (jnp.sqrt(mr) + eps), mode="drop")
+        return {"ParamOut": [p_out], "MomentOut": [m_out]}
     m_out = m + jnp.square(g)
     p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": [p_out], "MomentOut": [m_out]}
@@ -129,7 +170,7 @@ def adagrad(ctx, ins, attrs):
 )
 def decayed_adagrad(ctx, ins, attrs):
     p = single(ins, "Param")
-    g = single(ins, "Grad")
+    g = densify(single(ins, "Grad"))
     m = single(ins, "Moment")
     lr = single(ins, "LearningRate").reshape(())
     decay = attrs.get("decay", 0.95)
@@ -149,7 +190,7 @@ def decayed_adagrad(ctx, ins, attrs):
 )
 def adadelta(ctx, ins, attrs):
     p = single(ins, "Param")
-    g = single(ins, "Grad")
+    g = densify(single(ins, "Grad"))
     asg = single(ins, "AvgSquaredGrad")
     asu = single(ins, "AvgSquaredUpdate")
     rho = attrs.get("rho", 0.95)
@@ -175,7 +216,7 @@ def adadelta(ctx, ins, attrs):
 )
 def rmsprop(ctx, ins, attrs):
     p = single(ins, "Param")
-    g = single(ins, "Grad")
+    g = densify(single(ins, "Grad"))
     mom = single(ins, "Moment")
     ms = single(ins, "MeanSquare")
     mg = single(ins, "MeanGrad")
@@ -211,7 +252,7 @@ def rmsprop(ctx, ins, attrs):
 )
 def ftrl(ctx, ins, attrs):
     p = single(ins, "Param")
-    g = single(ins, "Grad")
+    g = densify(single(ins, "Grad"))
     sq = single(ins, "SquaredAccumulator")
     lin = single(ins, "LinearAccumulator")
     lr = single(ins, "LearningRate").reshape(())
