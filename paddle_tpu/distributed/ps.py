@@ -166,8 +166,28 @@ class ParameterServer:
         assert lns.type == "listen_and_serv"
         self.optimize_blocks = list(lns.attrs["optimize_blocks"])
 
+        # Distributed lookup-table shards (reference:
+        # distributed/parameter_prefetch.cc + the table optimize block):
+        # this server owns rows [start, end) of each table; table-shaped
+        # state initialized full-size by the shared startup program is
+        # sliced down so no server holds the whole table.
+        self.dist_tables = {d["name"]: d
+                            for d in lns.attrs.get("dist_tables", [])}
+        self._dist_block = {d["block"]: d for d in self.dist_tables.values()}
+        for d in self.dist_tables.values():
+            for n in d["sliced"]:
+                full = self.scope.get(n)
+                # slice only FULL-height state (a legacy un-transpiled
+                # startup); the per-endpoint startup from
+                # get_startup_program already initializes at shard shape
+                if (full is not None
+                        and np.asarray(full).shape[0] == d["vocab"]):
+                    self.scope.set(
+                        n, np.asarray(full)[d["start"]:d["end"]])
+
         self._lock = threading.Condition()
         self._grads = {}          # name -> list of arrays this batch
+        self._sparse_grads = {}   # table -> list of (rows, values)
         self._barriers = 0
         self._updated_batch = 0   # generation counter
         self._completed = 0
@@ -231,6 +251,21 @@ class ParameterServer:
                 with self._lock:
                     self._grads.setdefault(name, []).append(arr)
                 _send_msg(conn, ("ok",))
+            elif kind == "send_sparse":
+                _, name, rows, values = msg
+                with self._lock:
+                    self._sparse_grads.setdefault(name, []).append(
+                        (rows, values))
+                _send_msg(conn, ("ok",))
+            elif kind == "prefetch":
+                # shard-local row gather (reference:
+                # request_handler_impl.cc RequestPrefetchHandler); gather
+                # BEFORE np.asarray so a device-resident table transfers
+                # only the requested rows, not the whole shard
+                _, name, ids = msg
+                table = self.scope.get(name)
+                rows = np.asarray(table[ids.astype(np.int64)])
+                _send_msg(conn, ("var", rows))
             elif kind == "batch_barrier":
                 failed = False
                 with self._lock:
@@ -285,9 +320,52 @@ class ParameterServer:
         self._grads.clear()
         for name, val in avg.items():
             self.scope.set(name, val)
+        sparse = {
+            name: pairs for name, pairs in self._sparse_grads.items()
+        }
+        self._sparse_grads.clear()
         for bidx in self.optimize_blocks:
+            dist = self._dist_block.get(bidx)
+            if dist is None:
+                self.exe.engine.run_block(
+                    self.program.desc, bidx, self.scope, feed={},
+                    fetch_list=[])
+                continue
+            # NOTE: the block runs even when no trainer touched this shard
+            # this batch — its non-gradient ops (Adam beta-pow advance,
+            # momentum velocity decay) are per-step state the local run
+            # would also apply; a sentinel-only SelectedRows makes the
+            # gradient part a no-op.
+            pairs = sparse.get(dist["name"], [])
+            # Sync semantics = mean over trainers: concatenate all row
+            # slices and scale by 1/fanin — NOT 1/n_senders: a trainer
+            # whose batch hit no row of this shard sends nothing, which is
+            # a zero contribution to the mean, not a smaller denominator.
+            # Duplicates merge inside the optimizer lowering. Pad the row
+            # count up to a power-of-two bucket with the out-of-range
+            # sentinel so the compiled update executable is reused.
+            height = dist["end"] - dist["start"]
+            if pairs:
+                rows = np.concatenate(
+                    [r for r, _ in pairs]).astype(np.int64)
+                vals = np.concatenate(
+                    [np.asarray(v) for _, v in pairs]) / self.fanin
+            else:
+                # shape/dtype metadata only — no table transfer
+                table = self.scope.get(dist["name"])
+                rows = np.zeros((0,), np.int64)
+                vals = np.zeros((0, table.shape[1]), np.dtype(table.dtype))
+            bucket = 1 << max(0, int(np.ceil(np.log2(max(1, len(rows))))))
+            if bucket > len(rows):
+                pad = bucket - len(rows)
+                rows = np.concatenate(
+                    [rows, np.full(pad, height, np.int64)])
+                vals = np.concatenate(
+                    [vals, np.zeros((pad,) + vals.shape[1:], vals.dtype)])
             self.exe.engine.run_block(
-                self.program.desc, bidx, self.scope, feed={},
+                self.program.desc, bidx, self.scope,
+                feed={dist["name"] + "@GRAD@ROWS": rows,
+                      dist["name"] + "@GRAD@VALUES": vals},
                 fetch_list=[])
 
 
@@ -320,6 +398,22 @@ class PSClient:
         assert kind == "var"
         return val
 
+    def prefetch(self, ep, name, local_ids):
+        """Rows of a table shard by shard-local id (reference:
+        parameter_prefetch.cc prefetch_recv)."""
+        _send_msg(self._socks[ep],
+                  ("prefetch", name, np.asarray(local_ids, np.int64)))
+        kind, val = _recv_msg(self._socks[ep])
+        assert kind == "var", val
+        return val
+
+    def send_sparse(self, ep, name, local_rows, values):
+        _send_msg(self._socks[ep],
+                  ("send_sparse", name,
+                   np.asarray(local_rows, np.int64),
+                   np.asarray(values)))
+        assert _recv_msg(self._socks[ep])[0] == "ok"
+
     def send_complete(self):
         for s in self._socks.values():
             try:
@@ -347,6 +441,12 @@ class DistTrainer:
         self.program = trainer_program.clone()
         block = self.program.desc.global_block()
         kept = []
+        # distributed lookup tables: host-side prefetch/sparse-send per
+        # table (the marker ops stay in the program — they are real
+        # compiled ops; the transpiler records the routing)
+        self._dist = []    # (table, ids_var, pref_var, vocab, shards)
+        self._transpiler = transpiler
+        dist_tables = getattr(transpiler, "_dist_tables", {})
         for op in block.ops:
             if op.type == "send":
                 self._sends.append(
@@ -355,14 +455,29 @@ class DistTrainer:
                 self._recvs.append(
                     (op.outputs["Out"][0], op.attrs["endpoints"][0]))
             else:
+                if op.type == "distributed_lookup":
+                    wname = op.attrs["table_name"]
+                    self._dist.append(
+                        (wname, op.inputs["Ids"][0],
+                         op.inputs["Prefetched"][0],
+                         dist_tables[wname]["vocab"],
+                         dist_tables[wname]["shards"]))
                 kept.append(op)
         block.ops = kept
         self.program._bump_version()
-        eps = sorted({ep for _, ep in self._sends + self._recvs})
+        eps = sorted({ep for _, ep in self._sends + self._recvs}
+                     | {ep for *_, shards in self._dist
+                        for ep, _, _ in shards})
         self.client = PSClient(eps)
 
     def run_startup(self, startup_program):
         self.exe.run(startup_program, scope=self.scope)
+        # a caller may pass the un-transpiled startup; drop the full table
+        # AND its table-shaped optimizer state (Adam moments etc.) it
+        # initialized (get_trainer_startup_program avoids creating them)
+        if self._dist:
+            for name in self._transpiler.table_state_var_names():
+                self.scope.erase(name)
 
     def pull_params(self):
         """Initial sync so all trainers start from the pserver's params."""
@@ -370,14 +485,61 @@ class DistTrainer:
             self.scope.set(name, self.client.get_var(ep, name))
 
     def run(self, feed, fetch_list):
+        # -- prefetch distributed-table rows for this batch's ids ---------
+        # (reference: parameter_prefetch.cc — split ids by shard, RPC each
+        # owner, merge rows back in id order; deduplicated like
+        # merge_ids_op so each unique id crosses the wire once)
+        feed = dict(feed)
+        dist_ctx = []
+        for wname, ids_var, pref_var, vocab, shards in self._dist:
+            if ids_var not in feed:
+                raise ValueError(
+                    "distributed lookup table %r needs its ids %r in the "
+                    "feed" % (wname, ids_var))
+            flat = np.asarray(feed[ids_var]).reshape(-1)
+            if flat.size and (flat.min() < 0 or flat.max() >= vocab):
+                # the local lookup_table clamps via gather; silently
+                # dropping unowned ids here would train zero embeddings
+                raise ValueError(
+                    "ids for distributed table %r out of range [0, %d): "
+                    "min=%d max=%d" % (wname, vocab, flat.min(),
+                                       flat.max()))
+            uniq, inv = np.unique(flat, return_inverse=True)
+            rows = None
+            for ep, start, end in shards:
+                m = (uniq >= start) & (uniq < end)
+                if not m.any():
+                    continue
+                part = self.client.prefetch(ep, wname, uniq[m] - start)
+                if rows is None:
+                    rows = np.zeros((len(uniq), part.shape[-1]),
+                                    part.dtype)
+                rows[m] = part
+            assert rows is not None, "no shard owned any id"
+            feed[pref_var] = rows[inv]
+            dist_ctx.append((wname, pref_var + "@GRAD", uniq, inv, shards))
+
         grad_names = [g for g, _ in self._sends]
+        sparse_fetch = [g for _, g, *_ in dist_ctx]
         outs = self.exe.run(
             self.program, feed=feed,
-            fetch_list=list(fetch_list) + grad_names, scope=self.scope)
+            fetch_list=list(fetch_list) + grad_names + sparse_fetch,
+            scope=self.scope)
         n_fetch = len(fetch_list)
-        grads = dict(zip(grad_names, outs[n_fetch:]))
+        grads = dict(zip(grad_names + sparse_fetch, outs[n_fetch:]))
         for gname, ep in self._sends:
             self.client.send_var(ep, gname, grads[gname])
+        # -- sparse grads back to the shard owners, merged per unique id --
+        for wname, gname, uniq, inv, shards in dist_ctx:
+            vals = np.asarray(grads[gname])
+            merged = np.zeros((len(uniq), vals.shape[-1]), vals.dtype)
+            np.add.at(merged, inv, vals)
+            for ep, start, end in shards:
+                m = (uniq >= start) & (uniq < end)
+                if not m.any():
+                    continue
+                self.client.send_sparse(ep, wname, uniq[m] - start,
+                                        merged[m])
         self.client.batch_barrier()
         for pname, ep in self._recvs:
             self.scope.set(pname, self.client.get_var(ep, pname))

@@ -19,3 +19,4 @@ from paddle_tpu.ops import controlflow_ops  # noqa: F401
 from paddle_tpu.ops import quant_ops  # noqa: F401
 from paddle_tpu.ops import rnn_ops  # noqa: F401
 from paddle_tpu.ops import beam_search_ops  # noqa: F401
+from paddle_tpu.ops import distributed_ops  # noqa: F401

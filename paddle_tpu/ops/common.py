@@ -70,3 +70,20 @@ def flatten_to_2d(x, num_col_dims):
 def single(ins, slot, default=None):
     vals = ins.get(slot, [])
     return vals[0] if vals else default
+
+
+def flatten_lookup_ids(ids):
+    """lookup_table id normalization: a trailing dim of 1 is squeezed
+    (reference: lookup_table_op.cc treats ids as a column of indices)."""
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        return jnp.squeeze(ids, axis=-1)
+    return ids
+
+
+def zero_padding_rows(flat_ids, x, padding_idx):
+    """Zero the rows of ``x`` (one per id in ``flat_ids``, leading dims
+    aligned) whose id equals padding_idx; the padding row contributes
+    neither output nor gradient (reference: lookup_table_op.h)."""
+    if padding_idx is None or padding_idx < 0:
+        return x
+    return jnp.where((flat_ids == padding_idx)[..., None], 0.0, x)

@@ -283,15 +283,12 @@ def dropout(ctx, ins, attrs):
 
 @register_op("lookup_table", no_grad_inputs=("Ids",))
 def lookup_table(ctx, ins, attrs):
+    from paddle_tpu.ops.common import flatten_lookup_ids, zero_padding_rows
+
     w = single(ins, "W")
-    ids = single(ins, "Ids")
-    padding_idx = attrs.get("padding_idx", -1)
-    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
-    flat_ids = jnp.squeeze(ids, axis=-1) if squeeze_last else ids
+    flat_ids = flatten_lookup_ids(single(ins, "Ids"))
     out = jnp.take(w, flat_ids, axis=0)
-    if padding_idx is not None and padding_idx >= 0:
-        pad_mask = (flat_ids == padding_idx)[..., None]
-        out = jnp.where(pad_mask, 0.0, out)
+    out = zero_padding_rows(flat_ids, out, attrs.get("padding_idx", -1))
     return {"Out": [out]}
 
 
@@ -303,17 +300,14 @@ def lookup_table_grad(ctx, ins, attrs):
     the incoming output grads) — no table-sized tensor is ever built; the
     optimizer lowerings consume it with row-wise scatter updates."""
     from paddle_tpu.core.selected_rows import SelectedRows
+    from paddle_tpu.ops.common import flatten_lookup_ids, zero_padding_rows
 
     w = single(ins, "W")
-    ids = single(ins, "Ids")
     og = single(ins, "Out@GRAD")
-    padding_idx = attrs.get("padding_idx", -1)
-    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
-    flat_ids = jnp.squeeze(ids, axis=-1) if squeeze_last else ids
+    flat_ids = flatten_lookup_ids(single(ins, "Ids"))
     rows = flat_ids.reshape(-1).astype(jnp.int32)
     vals = og.reshape((rows.shape[0],) + tuple(w.shape[1:])).astype(w.dtype)
-    if padding_idx is not None and padding_idx >= 0:
-        vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+    vals = zero_padding_rows(rows, vals, attrs.get("padding_idx", -1))
     if attrs.get("is_sparse", False):
         return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
     dense = jnp.zeros_like(w).at[rows].add(vals)

@@ -20,6 +20,7 @@ listen_and_serv optimizer blocks — :280-952) and collective "nccl2"
   multi-process-on-localhost test topology).
 """
 
+from paddle_tpu.core.desc import VarDescData
 from paddle_tpu.framework import OP_ROLE_KEY, OpRole
 
 
@@ -68,6 +69,7 @@ class DistributeTranspiler:
         self.sync_mode = sync_mode
         self.origin_program = program or default_main_program()
         self.origin_startup = startup_program
+        self._dist_tables = {}
 
         if isinstance(trainers, str) or self.config.mode == "nccl2":
             # collective mode: endpoints string in `trainers`
@@ -78,13 +80,46 @@ class DistributeTranspiler:
 
         self._mode = "pserver"
         self.pserver_endpoints = [p for p in pservers.split(",") if p]
+
+        # Distributed lookup tables (reference:
+        # distribute_lookup_table.py:56 find_distributed_lookup_table):
+        # embedding params marked is_distributed are row-sharded across ALL
+        # pservers with runtime prefetch, not round-robin-assigned whole.
+        self._dist_tables = {}
+        block = self.origin_program.desc.global_block()
+        for op in block.ops:
+            if (op.type == "lookup_table"
+                    and op.attrs.get("is_distributed", False)):
+                wname = op.inputs["W"][0]
+                vd = block.find_var_recursive(wname)
+                vocab, dim = int(vd.shape[0]), int(vd.shape[1])
+                self._dist_tables[wname] = {
+                    "vocab": vocab,
+                    "dim": dim,
+                    "padding_idx": op.attrs.get("padding_idx", -1),
+                    "shards": self._shard_ranges(vocab),
+                }
+
         dispatcher = (self.config.split_method or RoundRobin)(
             self.pserver_endpoints)
         params = [
             p.name for p in self.origin_program.all_parameters()
+            if p.name not in self._dist_tables
         ]
         eps = dispatcher.dispatch(params)
         self._param_to_ep = dict(zip(params, eps))
+
+    def _shard_ranges(self, vocab):
+        """Contiguous row ranges per pserver (reference splits by blocks via
+        split_ids_op's mod sharding; contiguous keeps gathers local)."""
+        n = len(self.pserver_endpoints)
+        per = (vocab + n - 1) // n
+        out = []
+        for i, ep in enumerate(self.pserver_endpoints):
+            start = min(i * per, vocab)
+            end = min(start + per, vocab)
+            out.append((ep, start, end))
+        return out
 
     # -- collective --------------------------------------------------------
     def get_trainer_program(self, wait_port=True):
@@ -109,12 +144,19 @@ class DistributeTranspiler:
     def _build_trainer_program(self):
         """Trainer keeps forward+backward; optimizer ops for params owned by
         remote pservers are replaced by send/recv markers (reference:
-        get_trainer_program:554)."""
+        get_trainer_program:554). Distributed lookup tables additionally
+        have their lookup/grad ops swapped for the prefetch pair
+        (reference: distribute_lookup_table.py — the trainer never holds
+        the table; DistTrainer does the prefetch/sparse-send RPC)."""
         trainer = self.origin_program.clone()
         block = trainer.desc.global_block()
         remote_params = set(self._param_to_ep)
         new_ops = []
         sent = set()
+        # per-lookup prefetch vars: a table looked up twice (shared-vocab
+        # CTR embeddings) gets distinct prefetch/grad vars per lookup site
+        self._pref_by_out = {}
+        self._pref_count = {}
         for op in block.ops:
             role = int(op.attrs.get(OP_ROLE_KEY, 0))
             rv = op.attrs.get("op_role_var", [])
@@ -129,15 +171,139 @@ class DistributeTranspiler:
                         {"endpoints": [self._param_to_ep[pname]],
                          OP_ROLE_KEY: OpRole.RPC}))
                 continue
+            if self._dist_tables:
+                if (role & OpRole.Optimize
+                        and any(v in self._dist_tables for v in rv)):
+                    continue  # table updates happen on the shard owners
+                if (op.type == "lookup_table"
+                        and op.inputs["W"][0] in self._dist_tables):
+                    new_ops.append(self._rewrite_dist_lookup(block, op))
+                    continue
+                if (op.type == "lookup_table_grad"
+                        and op.inputs["W"][0] in self._dist_tables):
+                    new_ops.append(self._rewrite_dist_lookup_grad(block, op))
+                    continue
             new_ops.append(op)
         # recv updated params after the send barrier
         for pname, ep in self._param_to_ep.items():
             new_ops.append(_marker_op(
                 "recv", {}, {"Out": [pname]},
                 {"endpoints": [ep], OP_ROLE_KEY: OpRole.RPC}))
+        # The rewritten grad ops no longer produce the table's @GRAD
+        # contribution vars. Backward's dedup `sum` over them is dropped;
+        # any OTHER surviving consumer (gradient clip / regularization on
+        # the table) has no gradient to read — fail loudly rather than
+        # miscompute (the reference likewise keeps the distributed table
+        # out of clip/regularization, distribute_lookup_table.py).
+        if self._dist_tables:
+            dangling = set()
+            for wname in self._dist_tables:
+                dangling.add(wname + "@GRAD")
+                for vn in block.vars:
+                    if vn.startswith(wname + "@GRAD@"):
+                        dangling.add(vn)
+            kept = []
+            for op in new_ops:
+                ins = set(op.input_arg_names())
+                outs = set(op.output_arg_names())
+                if (op.type == "sum" and outs and outs <= dangling
+                        and ins <= dangling):
+                    continue
+                hit = ins & dangling
+                if hit:
+                    raise NotImplementedError(
+                        "op %r consumes gradient %s of a distributed "
+                        "lookup table; gradient clip/regularization on a "
+                        "distributed table is not supported" %
+                        (op.type, sorted(hit)))
+                kept.append(op)
+            new_ops = kept
         block.ops = new_ops
+        # the table itself no longer exists trainer-side
+        for wname in self._dist_tables:
+            block.vars.pop(wname, None)
         trainer._bump_version()
         return trainer
+
+    def table_state_var_names(self):
+        """Names of each distributed table and its table-shaped optimizer
+        state (Adam moments etc.) — state that lives only on shard owners
+        and must never be materialized trainer-side."""
+        src_block = self.origin_program.desc.global_block()
+        out = set()
+        for wname, info in self._dist_tables.items():
+            out.add(wname)
+            for op in self._ops_for_param(wname):
+                for n in op.input_arg_names() + op.output_arg_names():
+                    vd = src_block.find_var_recursive(n)
+                    if (vd is not None and vd.shape is not None
+                            and list(vd.shape) == [info["vocab"],
+                                                   info["dim"]]):
+                        out.add(n)
+        return out
+
+    def _new_prefetch_var(self, wname):
+        k = self._pref_count.get(wname, 0)
+        self._pref_count[wname] = k + 1
+        return "%s@PREFETCH.%d" % (wname, k)
+
+    def _ensure_var(self, block, name, shape):
+        if name not in block.vars:
+            block.vars[name] = VarDescData(name, shape=shape)
+
+    def _rewrite_dist_lookup(self, block, op):
+        wname = op.inputs["W"][0]
+        info = self._dist_tables[wname]
+        pref = self._new_prefetch_var(wname)
+        self._pref_by_out[op.outputs["Out"][0]] = pref
+        self._ensure_var(block, pref, [None, info["dim"]])
+        return _marker_op(
+            "distributed_lookup",
+            {"Prefetched": [pref], "Ids": list(op.inputs["Ids"])},
+            {"Out": list(op.outputs["Out"])},
+            # per-site padding_idx: two lookups of one table may differ
+            {"padding_idx": op.attrs.get("padding_idx", -1),
+             "table_name": wname,
+             OP_ROLE_KEY: int(op.attrs.get(OP_ROLE_KEY, 0))})
+
+    def _rewrite_dist_lookup_grad(self, block, op):
+        wname = op.inputs["W"][0]
+        info = self._dist_tables[wname]
+        # the grad op's Out@GRAD names the forward output's grad var;
+        # strip the suffix to find which lookup site this differentiates
+        og = op.inputs["Out@GRAD"][0]
+        from paddle_tpu.framework import grad_var_name
+
+        out_name = og[:-len("@GRAD")] if og.endswith("@GRAD") else og
+        pref = self._pref_by_out[out_name]
+        gname = grad_var_name(pref)
+        self._ensure_var(block, gname, [None, info["dim"]])
+        return _marker_op(
+            "distributed_lookup_grad",
+            {"Ids": list(op.inputs["Ids"]),
+             "Out@GRAD": list(op.inputs.get("Out@GRAD", []))},
+            {"Prefetched@GRAD": [gname]},
+            {"padding_idx": op.attrs.get("padding_idx", -1),
+             "table_name": wname,
+             OP_ROLE_KEY: int(op.attrs.get(OP_ROLE_KEY, 0))})
+
+    def get_trainer_startup_program(self):
+        """Trainer startup without the distributed tables' init — trainers
+        must never materialize the full table (reference:
+        distribute_transpiler delete_ops on the table init)."""
+        if self.origin_startup is None or not self._dist_tables:
+            return self.origin_startup
+        drop = self.table_state_var_names()
+        startup = self.origin_startup.clone()
+        block = startup.desc.global_block()
+        block.ops = [
+            op for op in block.ops
+            if not any(n in drop for n in op.output_arg_names())
+        ]
+        for n in drop:
+            block.vars.pop(n, None)
+        startup._bump_version()
+        return startup
 
     def get_pserver_program(self, endpoint):
         """One optimizer sub-block per owned param under a listen_and_serv
@@ -153,21 +319,55 @@ class DistributeTranspiler:
         for pname in owned:
             ops = self._ops_for_param(pname)
             sub = pserver.desc.append_block(0)
-            for op in ops:
-                sub.ops.append(_clone_op(op))
-                for n in op.input_arg_names() + op.output_arg_names():
-                    vd = src_block.find_var_recursive(n)
-                    if vd is not None and n not in dst_block.vars:
-                        import copy
-
-                        dst_block.vars[n] = copy.deepcopy(vd)
+            _clone_ops_into(sub, ops, src_block, dst_block)
             opt_blocks.append(sub.idx)
+
+        # Distributed lookup tables: every pserver owns one row-shard of
+        # every table. The optimizer sub-block is the ORIGINAL optimizer op
+        # fed by make_selected_rows assembling the wire (rows, values) into
+        # a SelectedRows grad; table-shaped vars are re-declared at shard
+        # shape (reference: the table optimize block of
+        # distribute_transpiler.py:952 _create_table_optimize_block).
+        dist_tables_attr = []
+        for wname, info in self._dist_tables.items():
+            shard = [s for s in info["shards"] if s[0] == endpoint]
+            if not shard:
+                continue
+            _, start, end = shard[0]
+            shard_rows = end - start
+            ops = self._ops_for_param(wname)
+            sub = pserver.desc.append_block(0)
+            rows_v, vals_v = wname + "@GRAD@ROWS", wname + "@GRAD@VALUES"
+            sub.ops.append(_marker_op(
+                "make_selected_rows",
+                {"Rows": [rows_v], "Values": [vals_v]},
+                {"Out": [wname + "@GRAD"]},
+                {"height": shard_rows, OP_ROLE_KEY: OpRole.Optimize}))
+            dst_block.vars[rows_v] = VarDescData(rows_v, dtype="int64")
+            dst_block.vars[vals_v] = VarDescData(vals_v)
+            touched = _clone_ops_into(sub, ops, src_block, dst_block)
+            # re-declare table-shaped state at shard shape
+            sliced = set()
+            for n in touched:
+                nd = dst_block.vars[n]
+                if (nd.shape is not None
+                        and list(nd.shape) == [info["vocab"], info["dim"]]):
+                    nd.shape = [shard_rows, info["dim"]]
+                    sliced.add(n)
+            dist_tables_attr.append({
+                "name": wname, "start": start, "end": end,
+                "vocab": info["vocab"], "block": sub.idx,
+                "sliced": sorted(sliced),
+            })
+            opt_blocks.append(sub.idx)
+
         dst_block.ops.append(_marker_op(
             "listen_and_serv", {}, {},
             {"endpoint": endpoint,
              "optimize_blocks": opt_blocks,
              "Fanin": self.trainer_num,
              "sync_mode": self.sync_mode,
+             "dist_tables": dist_tables_attr,
              OP_ROLE_KEY: OpRole.RPC}))
         pserver._bump_version()
         pserver.blocks = pserver.blocks[:1]
@@ -184,13 +384,57 @@ class DistributeTranspiler:
 
     def get_startup_program(self, endpoint=None, pserver_program=None,
                             startup_program=None):
-        """Pserver startup: initialize only the owned params' state
-        (reference: get_startup_program:927)."""
-        return self.origin_startup
+        """Pserver startup. For distributed lookup tables the init ops of
+        table-shaped vars are rewritten to this endpoint's SHARD shape so
+        no server ever materializes the whole table — the memory contract
+        the sharding exists for (reference: get_startup_program:927 slices
+        param init blocks the same way)."""
+        base = startup_program or self.origin_startup
+        if base is None or not self._dist_tables or endpoint is None:
+            return base
+        if pserver_program is None:
+            pserver_program = self.get_pserver_program(endpoint)
+        lns = pserver_program.desc.global_block().ops[-1]
+        resize = {}  # var -> shard rows
+        for d in lns.attrs.get("dist_tables", []):
+            for n in d["sliced"]:
+                resize[n] = d["end"] - d["start"]
+        startup = base.clone()
+        block = startup.desc.global_block()
+        for op in block.ops:
+            for n in op.output_arg_names():
+                if n in resize and "shape" in op.attrs:
+                    shape = list(op.attrs["shape"])
+                    shape[0] = resize[n]
+                    op.attrs["shape"] = shape
+        for n, rows in resize.items():
+            vd = block.vars.get(n)
+            if vd is not None and vd.shape:
+                vd.shape = [rows] + list(vd.shape[1:])
+        startup._bump_version()
+        return startup
 
     def get_pserver_programs(self, endpoint):
-        return (self.get_pserver_program(endpoint),
-                self.get_startup_program(endpoint))
+        pserver = self.get_pserver_program(endpoint)
+        return (pserver, self.get_startup_program(endpoint, pserver))
+
+
+def _clone_ops_into(sub, ops, src_block, dst_block):
+    """Clone ops into a pserver sub-block, copying the var descs they
+    touch into the root block; returns the touched var names."""
+    import copy
+
+    touched = []
+    for op in ops:
+        sub.ops.append(_clone_op(op))
+        for n in op.input_arg_names() + op.output_arg_names():
+            vd = src_block.find_var_recursive(n)
+            if vd is None:
+                continue
+            if n not in dst_block.vars:
+                dst_block.vars[n] = copy.deepcopy(vd)
+            touched.append(n)
+    return touched
 
 
 def _marker_op(type_, inputs, outputs, attrs):

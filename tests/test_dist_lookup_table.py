@@ -1,0 +1,246 @@
+"""Distributed lookup table: the embedding is row-sharded across pservers
+with runtime prefetch and sparse gradient pushback — trainers and servers
+never hold the full table (reference:
+python/paddle/fluid/distribute_lookup_table.py:56,
+operators/distributed/parameter_prefetch.cc,
+operators/distributed_ops/merge_ids_op.cc)."""
+
+import socket
+import threading
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed.ps import ParameterServer, DistTrainer
+from paddle_tpu.framework import Program, program_guard
+
+VOCAB, DIM, FIELDS = 64, 4, 5
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build(lr=0.2, is_distributed=False, optimizer="sgd"):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[FIELDS], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[VOCAB, DIM], is_sparse=True,
+            is_distributed=is_distributed,
+            param_attr=fluid.ParamAttr(name="emb_w"))
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(input=pooled, size=4,
+                               param_attr=fluid.ParamAttr(name="fc_w"))
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        if optimizer == "adam":
+            fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+        else:
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, batch, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(VOCAB).astype(np.float32)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, VOCAB, (batch, FIELDS)).astype(np.int64)
+        yv = (np.stack([W[ids].sum(1), -W[ids].sum(1),
+                        W[ids].max(1), W[ids].min(1)], 1)
+              .argmax(1).astype(np.int64).reshape(-1, 1))
+        out.append({"ids": ids, "y": yv})
+    return out
+
+
+import pytest
+
+
+@pytest.mark.parametrize("optimizer,lr", [("sgd", 0.2), ("adam", 0.05)])
+def test_distributed_lookup_table_matches_local(optimizer, lr):
+    n_steps, full_batch = 8, 32
+    batches = _batches(n_steps, full_batch)
+    emb0 = np.linspace(-0.5, 0.5, VOCAB * DIM).astype(np.float32).reshape(
+        VOCAB, DIM)
+
+    # ---- local reference run --------------------------------------------
+    main, startup, loss = _build(lr=lr, optimizer=optimizer)
+    exe = fluid.Executor()
+    local_scope = fluid.Scope()
+    exe.run(startup, scope=local_scope)
+    local_scope.set("emb_w", emb0.copy())
+    init_vals = {
+        p.name: np.asarray(local_scope.get(p.name))
+        for p in main.all_parameters()
+    }
+    local_losses = []
+    for b in batches:
+        (l,) = exe.run(main, feed=b, fetch_list=[loss], scope=local_scope)
+        local_losses.append(float(np.asarray(l)))
+    local_table = np.asarray(local_scope.get("emb_w"))
+
+    # ---- transpile with a distributed table -----------------------------
+    main2, startup2, loss2 = _build(lr=lr, is_distributed=True,
+                                    optimizer=optimizer)
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main2, pservers=",".join(eps),
+                trainers=2, startup_program=startup2)
+    assert "emb_w" in t._dist_tables
+    shards = t._dist_tables["emb_w"]["shards"]
+    trainer_prog = t.get_trainer_program()
+    trainer_startup = t.get_trainer_startup_program()
+
+    # the table is gone from the trainer program and startup
+    tb = trainer_prog.desc.global_block()
+    assert "emb_w" not in tb.vars
+    assert all("emb_w" != n for op in tb.ops for n in op.input_arg_names())
+    sb = trainer_startup.desc.global_block()
+    assert all("emb_w" not in op.output_arg_names() for op in sb.ops)
+
+    # ---- pservers --------------------------------------------------------
+    servers = []
+    for ep in eps:
+        ps_prog = t.get_pserver_program(ep)
+        # per-endpoint startup: table-shaped state is initialized at SHARD
+        # shape — no server ever materializes the whole table
+        ps_startup = t.get_startup_program(ep, ps_prog)
+        srv = ParameterServer(ps_prog, ps_startup, ep, fanin=2)
+        for name in srv.scope.local_var_names():
+            val = srv.scope.get(name)
+            if val is not None and hasattr(val, "shape"):
+                assert tuple(val.shape) != (VOCAB, DIM), name
+        for name, val in init_vals.items():
+            if name == "emb_w":
+                continue
+            srv.scope.set(name, val)
+        (start, end) = next((s, e) for e2, s, e in shards if e2 == ep)
+        srv.scope.set("emb_w", emb0[start:end].copy())
+        # no server holds the whole table
+        assert np.asarray(srv.scope.get("emb_w")).shape == (end - start, DIM)
+        srv.start()
+        servers.append(srv)
+
+    # ---- trainers --------------------------------------------------------
+    half = full_batch // 2
+    results = [None, None]
+    scopes = [None, None]
+
+    def run_trainer(tid):
+        trainer = DistTrainer(trainer_prog, t)
+        trainer.run_startup(trainer_startup)
+        trainer.pull_params()
+        losses = []
+        for b in batches:
+            sl = slice(tid * half, (tid + 1) * half)
+            feed = {"ids": b["ids"][sl], "y": b["y"][sl]}
+            (l,) = trainer.run(feed, [loss2.name])
+            losses.append(float(np.asarray(l)))
+        scopes[tid] = trainer.scope
+        trainer.close()
+        results[tid] = losses
+
+    threads = [threading.Thread(target=run_trainer, args=(i,))
+               for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert all(r is not None for r in results), "a trainer died"
+
+    # trainers never materialized the table
+    for sc in scopes:
+        for name in sc.local_var_names():
+            v = sc.get(name)
+            if v is not None and hasattr(v, "shape"):
+                assert tuple(v.shape) != (VOCAB, DIM), name
+
+    # averaged half-batch losses == the local full-batch trajectory
+    dist_losses = [(a + b) / 2 for a, b in zip(*results)]
+    np.testing.assert_allclose(dist_losses, local_losses, rtol=1e-4,
+                               atol=1e-5)
+    assert dist_losses[-1] < dist_losses[0]
+
+    # the sharded table equals the locally-trained one
+    dist_table = np.concatenate([
+        np.asarray(srv.scope.get("emb_w")) for srv in servers
+    ])
+    np.testing.assert_allclose(dist_table, local_table, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_disjoint_shard_usage_scales_by_fanin():
+    """A shard that only ONE trainer's batch touches must still divide by
+    fanin (mean-over-trainers), not by the number of senders: trainer 0
+    uses only shard-0 ids, trainer 1 only shard-1 ids."""
+    full_batch = 8
+    rng = np.random.RandomState(3)
+    ids0 = rng.randint(0, VOCAB // 2, (full_batch // 2, FIELDS))
+    ids1 = rng.randint(VOCAB // 2, VOCAB, (full_batch // 2, FIELDS))
+    ids = np.concatenate([ids0, ids1]).astype(np.int64)
+    yv = (ids.sum(1, keepdims=True) % 4).astype(np.int64)
+    batches = [{"ids": ids, "y": yv}]
+    emb0 = np.linspace(-0.5, 0.5, VOCAB * DIM).astype(np.float32).reshape(
+        VOCAB, DIM)
+
+    main, startup, loss = _build()
+    exe = fluid.Executor()
+    local_scope = fluid.Scope()
+    exe.run(startup, scope=local_scope)
+    local_scope.set("emb_w", emb0.copy())
+    init_vals = {p.name: np.asarray(local_scope.get(p.name))
+                 for p in main.all_parameters()}
+    exe.run(main, feed=batches[0], fetch_list=[loss], scope=local_scope)
+    local_table = np.asarray(local_scope.get("emb_w"))
+
+    main2, startup2, loss2 = _build(is_distributed=True)
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main2, pservers=",".join(eps),
+                trainers=2, startup_program=startup2)
+    shards = t._dist_tables["emb_w"]["shards"]
+    trainer_prog = t.get_trainer_program()
+    trainer_startup = t.get_trainer_startup_program()
+    servers = []
+    for ep in eps:
+        srv = ParameterServer(t.get_pserver_program(ep), startup2, ep,
+                              fanin=2)
+        for name, val in init_vals.items():
+            if name != "emb_w":
+                srv.scope.set(name, val)
+        (start, end) = next((s, e) for e2, s, e in shards if e2 == ep)
+        srv.scope.set("emb_w", emb0[start:end].copy())
+        srv.start()
+        servers.append(srv)
+
+    results = [None, None]
+
+    def run_trainer(tid):
+        trainer = DistTrainer(trainer_prog, t)
+        trainer.run_startup(trainer_startup)
+        trainer.pull_params()
+        half = full_batch // 2
+        sl = slice(tid * half, (tid + 1) * half)
+        trainer.run({"ids": ids[sl], "y": yv[sl]}, [loss2.name])
+        trainer.close()
+        results[tid] = True
+
+    threads = [threading.Thread(target=run_trainer, args=(i,))
+               for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert all(results), "a trainer died"
+
+    dist_table = np.concatenate([
+        np.asarray(srv.scope.get("emb_w")) for srv in servers
+    ])
+    np.testing.assert_allclose(dist_table, local_table, rtol=1e-4,
+                               atol=1e-6)
