@@ -1,25 +1,56 @@
 """DataFeeder (reference: python/paddle/fluid/data_feeder.py) — converts
 python/numpy minibatch rows into the feed dict. The reference builds
-LoDTensors; here ragged int sequences become padded arrays + implicit
-lengths (the TPU-native LoD equivalent)."""
+LoDTensors; here ragged sequences become padded arrays + explicit
+lengths (the TPU-native LoD equivalent):
+
+* each ragged column's per-row lengths are emitted under
+  ``<name>@LEN`` whenever the program declares a var of that name, so
+  models thread them into the length-aware sequence ops / DynamicRNN —
+  the padded-world analog of LoD metadata riding the tensor
+  (reference: framework/lod_tensor.h:44);
+* ragged time dims are padded up to power-of-two BUCKETS (not the batch
+  max), so 20 distinct batch shapes compile a handful of executables
+  instead of 20 — SURVEY §7's recompilation hazard. Padding further than
+  the batch max is semantically free because the length masks define the
+  valid region. Disable with bucket_seq=False to pad to the exact max.
+"""
 
 import numpy as np
 
 from paddle_tpu.core.types import convert_dtype_to_np
 
+LENGTH_SUFFIX = "@LEN"
+
+_MIN_BUCKET = 8
+
+
+def bucketed_length(n, min_bucket=_MIN_BUCKET):
+    """Round n up to a power-of-two bucket (shared by the DataFeeder and
+    the pserver's sparse-row padding so the policies never diverge)."""
+    b = max(1, min_bucket)
+    while b < n:
+        b *= 2
+    return b
+
 
 class DataFeeder:
-    def __init__(self, feed_list, place, program=None):
+    def __init__(self, feed_list, place, program=None, bucket_seq=True):
+        from paddle_tpu.framework import default_main_program
+
         self.feed_names = []
         self.feed_vars = []
+        self.program = program or default_main_program()
+        self.bucket_seq = bucket_seq
         for v in feed_list:
             if isinstance(v, str):
-                from paddle_tpu.framework import default_main_program
-
-                v = (program or default_main_program()).global_block().var(v)
+                v = self.program.global_block().var(v)
             self.feed_vars.append(v)
             self.feed_names.append(v.name)
         self.place = place
+
+    def _has_length_var(self, name):
+        block = self.program.global_block()
+        return block.desc.find_var_recursive(name + LENGTH_SUFFIX) is not None
 
     def feed(self, iterable):
         """iterable: list of rows, each row a tuple matching feed_list."""
@@ -29,11 +60,20 @@ class DataFeeder:
             dtype = convert_dtype_to_np(var.dtype)
             arrs = [np.asarray(x, dtype=dtype) for x in col]
             shapes = {a.shape for a in arrs}
-            if len(shapes) == 1:
+            ragged = len(shapes) != 1
+            # a declared <name>@LEN var marks a sequence column even when
+            # this particular batch happens to be uniform (e.g. B=1) —
+            # lengths and bucketing must still apply, or the model's
+            # length feed goes missing and every uniform length compiles
+            # its own executable
+            is_seq = ragged or self._has_length_var(var.name)
+            if not is_seq:
                 batch = np.stack(arrs)
             else:
-                # ragged: right-pad to max length on axis 0
+                # sequence: right-pad axis 0 to a bucketed length
                 maxlen = max(a.shape[0] for a in arrs)
+                if self.bucket_seq:
+                    maxlen = bucketed_length(maxlen)
                 trail = arrs[0].shape[1:]
                 batch = np.zeros((len(arrs), maxlen) + trail, dtype=dtype)
                 for i, a in enumerate(arrs):
@@ -44,4 +84,7 @@ class DataFeeder:
                 if shape[-1] == 1:
                     batch = batch[..., None]
             out[var.name] = batch
+            if is_seq and self._has_length_var(var.name):
+                out[var.name + LENGTH_SUFFIX] = np.asarray(
+                    [a.shape[0] for a in arrs], dtype=np.int64)
         return out

@@ -151,7 +151,7 @@ class ParameterServer:
     """
 
     def __init__(self, pserver_program, startup_program, endpoint, fanin,
-                 scope=None):
+                 scope=None, checkpoint_dir=None):
         import paddle_tpu.fluid as fluid
 
         self.program = pserver_program
@@ -165,6 +165,12 @@ class ParameterServer:
         lns = self.program.desc.global_block().ops[-1]
         assert lns.type == "listen_and_serv"
         self.optimize_blocks = list(lns.attrs["optimize_blocks"])
+        # Async mode (reference: listen_and_serv_op.cc RunAsyncLoop):
+        # each arriving gradient immediately runs its param's optimize
+        # block — no barriers, no cross-trainer averaging.
+        self.sync_mode = bool(lns.attrs.get("sync_mode", True))
+        self._grad_to_block = dict(zip(
+            lns.attrs.get("block_grads", []), self.optimize_blocks))
 
         # Distributed lookup-table shards (reference:
         # distributed/parameter_prefetch.cc + the table optimize block):
@@ -188,6 +194,8 @@ class ParameterServer:
         self._lock = threading.Condition()
         self._grads = {}          # name -> list of arrays this batch
         self._sparse_grads = {}   # table -> list of (rows, values)
+        if checkpoint_dir is not None:
+            self.load_checkpoint(checkpoint_dir)
         self._barriers = 0
         self._updated_batch = 0   # generation counter
         self._completed = 0
@@ -207,6 +215,17 @@ class ParameterServer:
         with self._lock:
             while not self._stop:
                 self._lock.wait(timeout=0.1)
+        # Unblock the accept() syscall before closing: closing an fd
+        # another thread is blocked in accept() on does NOT cancel the
+        # syscall on Linux — the kernel keeps the socket (and the port)
+        # alive until accept returns, so a quick restart on the same
+        # endpoint would fail with EADDRINUSE.
+        try:
+            host, port = self.endpoint.rsplit(":", 1)
+            socket.create_connection((host, int(port)), timeout=1).close()
+        except OSError:
+            pass
+        accept_thread.join(timeout=2)
         self._sock.close()
 
     def start(self):
@@ -248,15 +267,37 @@ class ParameterServer:
             kind = msg[0]
             if kind == "send":
                 _, name, arr = msg
-                with self._lock:
-                    self._grads.setdefault(name, []).append(arr)
+                if self.sync_mode:
+                    with self._lock:
+                        self._grads.setdefault(name, []).append(arr)
+                else:
+                    # RunAsyncLoop: apply this trainer's gradient now
+                    # (serialized by the lock — the consistency level of
+                    # the reference's per-block executor, without
+                    # cross-trainer barriers)
+                    with self._lock:
+                        self._apply_async_dense(name, arr)
                 _send_msg(conn, ("ok",))
             elif kind == "send_sparse":
                 _, name, rows, values = msg
-                with self._lock:
-                    self._sparse_grads.setdefault(name, []).append(
-                        (rows, values))
+                if self.sync_mode:
+                    with self._lock:
+                        self._sparse_grads.setdefault(name, []).append(
+                            (rows, values))
+                else:
+                    with self._lock:
+                        self._apply_sparse(name, [(rows, values)], scale=1.0)
                 _send_msg(conn, ("ok",))
+            elif kind == "checkpoint":
+                # reference: checkpoint_notify_op.cc:28 — each pserver
+                # saves its own shard of the persistables
+                _, dirname = msg
+                try:
+                    with self._lock:
+                        self.save_checkpoint(dirname)
+                    _send_msg(conn, ("ok",))
+                except OSError as e:
+                    _send_msg(conn, ("error", "checkpoint failed: %s" % e))
             elif kind == "prefetch":
                 # shard-local row gather (reference:
                 # request_handler_impl.cc RequestPrefetchHandler); gather
@@ -267,6 +308,10 @@ class ParameterServer:
                 rows = np.asarray(table[ids.astype(np.int64)])
                 _send_msg(conn, ("var", rows))
             elif kind == "batch_barrier":
+                if not self.sync_mode:
+                    # async mode has no barriers (RunAsyncLoop)
+                    _send_msg(conn, ("ok",))
+                    continue
                 failed = False
                 with self._lock:
                     self._barriers += 1
@@ -335,38 +380,97 @@ class ParameterServer:
             # this batch — its non-gradient ops (Adam beta-pow advance,
             # momentum velocity decay) are per-step state the local run
             # would also apply; a sentinel-only SelectedRows makes the
-            # gradient part a no-op.
-            pairs = sparse.get(dist["name"], [])
-            # Sync semantics = mean over trainers: concatenate all row
-            # slices and scale by 1/fanin — NOT 1/n_senders: a trainer
-            # whose batch hit no row of this shard sends nothing, which is
-            # a zero contribution to the mean, not a smaller denominator.
-            # Duplicates merge inside the optimizer lowering. Pad the row
-            # count up to a power-of-two bucket with the out-of-range
-            # sentinel so the compiled update executable is reused.
-            height = dist["end"] - dist["start"]
-            if pairs:
-                rows = np.concatenate(
-                    [r for r, _ in pairs]).astype(np.int64)
-                vals = np.concatenate(
-                    [np.asarray(v) for _, v in pairs]) / self.fanin
-            else:
-                # shape/dtype metadata only — no table transfer
-                table = self.scope.get(dist["name"])
-                rows = np.zeros((0,), np.int64)
-                vals = np.zeros((0, table.shape[1]), np.dtype(table.dtype))
-            bucket = 1 << max(0, int(np.ceil(np.log2(max(1, len(rows))))))
-            if bucket > len(rows):
-                pad = bucket - len(rows)
-                rows = np.concatenate(
-                    [rows, np.full(pad, height, np.int64)])
-                vals = np.concatenate(
-                    [vals, np.zeros((pad,) + vals.shape[1:], vals.dtype)])
-            self.exe.engine.run_block(
-                self.program.desc, bidx, self.scope,
-                feed={dist["name"] + "@GRAD@ROWS": rows,
-                      dist["name"] + "@GRAD@VALUES": vals},
-                fetch_list=[])
+            # gradient part a no-op. Sync semantics = mean over trainers:
+            # scale by 1/fanin, NOT 1/n_senders (a trainer whose batch hit
+            # no row of this shard sends nothing — a zero contribution to
+            # the mean, not a smaller denominator).
+            self._apply_sparse(dist["name"], sparse.get(dist["name"], []),
+                               scale=1.0 / self.fanin, block_idx=bidx)
+
+    def _apply_async_dense(self, grad_name, arr):
+        bidx = self._grad_to_block.get(grad_name)
+        if bidx is None:
+            raise ValueError("no optimize block for gradient %r" % grad_name)
+        self.scope.set(grad_name, arr)
+        self.exe.engine.run_block(
+            self.program.desc, bidx, self.scope, feed={}, fetch_list=[])
+
+    def _apply_sparse(self, table_name, pairs, scale, block_idx=None):
+        """Run a distributed table's optimize block on (rows, values)
+        pairs; rows bucketed to powers of two with the sentinel row so one
+        executable serves all batch sizes."""
+        dist = self.dist_tables[table_name]
+        if block_idx is None:
+            block_idx = dist["block"]
+        height = dist["end"] - dist["start"]
+        if pairs:
+            rows = np.concatenate([r for r, _ in pairs]).astype(np.int64)
+            vals = np.concatenate(
+                [np.asarray(v) for _, v in pairs]) * scale
+        else:
+            # shape/dtype metadata only — no table transfer
+            table = self.scope.get(table_name)
+            rows = np.zeros((0,), np.int64)
+            vals = np.zeros((0, table.shape[1]), np.dtype(table.dtype))
+        from paddle_tpu.data_feeder import bucketed_length
+
+        bucket = bucketed_length(len(rows), min_bucket=1)
+        if bucket > len(rows):
+            pad = bucket - len(rows)
+            rows = np.concatenate([rows, np.full(pad, height, np.int64)])
+            vals = np.concatenate(
+                [vals, np.zeros((pad,) + vals.shape[1:], vals.dtype)])
+        self.exe.engine.run_block(
+            self.program.desc, block_idx, self.scope,
+            feed={table_name + "@GRAD@ROWS": rows,
+                  table_name + "@GRAD@VALUES": vals},
+            fetch_list=[])
+
+    # -- distributed checkpointing -----------------------------------------
+    def _owned_persistables(self):
+        """Persistable vars this server's optimize blocks touch — its shard
+        of the model (reference: io.py:261 _save_distributed_persistables
+        gathers exactly the pserver-side vars)."""
+        names = set()
+        gb = self.program.desc.global_block()
+        for bidx in self.optimize_blocks:
+            bd = self.program.desc.block(bidx)
+            for op in bd.ops:
+                for n in op.input_arg_names() + op.output_arg_names():
+                    vd = gb.find_var_recursive(n)
+                    if vd is not None and vd.persistable:
+                        names.add(n)
+        return sorted(names)
+
+    def _checkpoint_path(self, dirname):
+        import os
+
+        tag = self.endpoint.replace(":", "_").replace("/", "_")
+        return os.path.join(dirname, "pserver_%s.npz" % tag)
+
+    def save_checkpoint(self, dirname):
+        """Save this server's shard (reference: checkpoint_notify_op.cc:28
+        -> RequestCheckpointHandler saving the owned vars)."""
+        import os
+
+        os.makedirs(dirname, exist_ok=True)
+        arrays = {}
+        for n in self._owned_persistables():
+            v = self.scope.get(n)
+            if v is not None:
+                arrays[n] = np.asarray(v)
+        np.savez(self._checkpoint_path(dirname), **arrays)
+
+    def load_checkpoint(self, dirname):
+        import os
+
+        path = self._checkpoint_path(dirname)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                "no checkpoint for %s at %s" % (self.endpoint, path))
+        with np.load(path) as data:
+            for n in data.files:
+                self.scope.set(n, data[n])
 
 
 # -- client ----------------------------------------------------------------
@@ -413,6 +517,15 @@ class PSClient:
                    np.asarray(local_rows, np.int64),
                    np.asarray(values)))
         assert _recv_msg(self._socks[ep])[0] == "ok"
+
+    def checkpoint_notify(self, dirname):
+        """Ask every pserver to save its shard (reference:
+        checkpoint_notify_op.cc:28)."""
+        for s in self._socks.values():
+            _send_msg(s, ("checkpoint", dirname))
+        for s in self._socks.values():
+            reply = _recv_msg(s)
+            assert reply is not None and reply[0] == "ok", reply
 
     def send_complete(self):
         for s in self._socks.values():
@@ -544,6 +657,12 @@ class DistTrainer:
         for pname, ep in self._recvs:
             self.scope.set(pname, self.client.get_var(ep, pname))
         return outs[:n_fetch]
+
+    def save_checkpoint(self, dirname):
+        """Distributed checkpoint: every pserver saves its own shard
+        (reference: io.py:261 _save_distributed_persistables +
+        checkpoint_notify)."""
+        self.client.checkpoint_notify(dirname)
 
     def close(self):
         self.client.send_complete()

@@ -32,9 +32,18 @@ class Engine:
     """One engine per Executor; owns the executable cache."""
 
     def __init__(self, place=None):
+        import os
+
         self.place = place
         self._cache = {}
         self._run_counter = 0
+        # Debug guard (reference: FLAGS_check_nan_inf,
+        # framework/operator.cc:972-982): verify every fetch and persisted
+        # state tensor is finite after each step. Whole-step granularity —
+        # per-op checking would break XLA fusion; this catches the blast-up
+        # at the same user-visible seam.
+        self.check_nan_inf = os.environ.get(
+            "PADDLE_TPU_CHECK_NAN_INF", "0") not in ("0", "", "false")
 
     # -- public ------------------------------------------------------------
     def run_block(
@@ -53,6 +62,7 @@ class Engine:
         shard_rules=None,
         data_axes=("dp",),
         amp=False,
+        accumulate_steps=1,
     ):
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -82,6 +92,7 @@ class Engine:
             is_test,
             donate_state,
             amp,
+            accumulate_steps,
             cache_key_extra,
         )
 
@@ -91,6 +102,7 @@ class Engine:
                 block, feed_names, fetch_list, is_test, donate_state,
                 mesh=mesh, feed_values=feed_values,
                 shard_rules=shard_rules, data_axes=data_axes, amp=amp,
+                accumulate_steps=accumulate_steps,
             )
             self._cache[key] = compiled
 
@@ -106,6 +118,11 @@ class Engine:
 
         fetches, state_out = compiled.jitted(feed_values, mutated, readonly,
                                              rng_seed)
+
+        if self.check_nan_inf:
+            _check_finite(
+                zip(compiled.block_program.state_out_names, state_out))
+            _check_finite(zip(fetch_list, fetches))
 
         for name, val in zip(compiled.block_program.state_out_names, state_out):
             scope.set(name, val)
@@ -130,9 +147,16 @@ class Engine:
     # -- internals ---------------------------------------------------------
     def _compile(self, block, feed_names, fetch_list, is_test, donate_state,
                  mesh=None, feed_values=None, shard_rules=None,
-                 data_axes=("dp",), amp=False):
+                 data_axes=("dp",), amp=False, accumulate_steps=1):
         bp = BlockProgram(block, feed_names, fetch_list, ())
-        fn = lower_block(bp, is_test=is_test, executor=self, amp=amp)
+        if accumulate_steps > 1:
+            from paddle_tpu.engine.lowering import lower_block_accumulated
+
+            fn = lower_block_accumulated(
+                bp, accumulate_steps, is_test=is_test, executor=self,
+                amp=amp)
+        else:
+            fn = lower_block(bp, is_test=is_test, executor=self, amp=amp)
 
         out_set = set(bp.state_out_names)
         mutated = [n for n in bp.state_in_names if n in out_set]
@@ -198,3 +222,20 @@ class Engine:
             )
         jitted = jax.jit(wrapped, donate_argnums=donate, **jit_kwargs)
         return CompiledBlock(bp, jitted, mutated, readonly)
+
+
+def _check_finite(named_values):
+    """Raise naming the first non-finite float tensor (reference error
+    contract: operator.cc:976 'Operator %s output Tensor %s contains Inf'
+    — here at step granularity)."""
+    import jax.numpy as jnp
+
+    for name, val in named_values:
+        if not hasattr(val, "dtype") or not jnp.issubdtype(
+                jnp.asarray(val).dtype, jnp.floating):
+            continue
+        if not bool(jnp.isfinite(val).all()):
+            raise RuntimeError(
+                "check_nan_inf: tensor %r contains NaN or Inf after this "
+                "step (reference: FLAGS_check_nan_inf, "
+                "framework/operator.cc:972)" % name)

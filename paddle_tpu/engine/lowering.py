@@ -287,3 +287,138 @@ def np_value_for_var(var_desc, value):
     dtype = convert_dtype_to_np(var_desc.dtype)
     arr = np.asarray(value, dtype=dtype)
     return arr
+
+
+def lower_block_accumulated(block_program, k, is_test=False, executor=None,
+                            amp=False):
+    """Gradient-accumulation lowering: the forward/backward segment runs as
+    a ``lax.scan`` over ``k`` micro-batches (feeds reshaped [k, B/k, ...]),
+    gradients crossing into the optimizer segment are averaged, and the
+    optimizer/LR ops run ONCE on the averaged gradients — the compiled-scan
+    form of the reference's batch-merge capability (reference:
+    paddle/fluid/framework/ir/multi_batch_merge_pass.cc, which repeats the
+    fwd/bwd subgraph k times and sums grads before the update).
+
+    Numerics: mean-reduced losses make k-step accumulation EXACTLY equal to
+    one k*B batch (mean of micro-batch grads == big-batch grad), including
+    global-norm clipping, which sees the averaged grads. Persistable state
+    written inside the scan (BN running stats) updates sequentially per
+    micro-batch, like k real steps would.
+    """
+    import jax
+
+    from paddle_tpu.core.registry import amp_scope
+    from paddle_tpu.core.selected_rows import SelectedRows, densify
+
+    block = block_program.block
+    feed_names = block_program.feed_names
+    state_in_names = block_program.state_in_names
+
+    from paddle_tpu.framework import OpRole
+
+    ONCE_ROLES = OpRole.Optimize | OpRole.RPC | OpRole.LRSched
+    scan_ops, once_ops = [], []
+    for op in block_program.ops:
+        role = int(op.attrs.get("op_role", 0))
+        (once_ops if role & ONCE_ROLES else scan_ops).append(op)
+
+    def _is_persistable(name):
+        vd = block.find_var_recursive(name)
+        return vd is not None and vd.persistable
+
+    written_scan = []
+    for op in scan_ops:
+        for n in op.output_arg_names():
+            if n != EMPTY_VAR_NAME and n not in written_scan:
+                written_scan.append(n)
+    written_scan_set = set(written_scan)
+    read_once = set()
+    for op in once_ops:
+        read_once.update(
+            n for n in op.input_arg_names() if n != EMPTY_VAR_NAME)
+
+    state_in_set = set(state_in_names)
+    # loop-carried: persistable vars the scan both reads and writes
+    # (BN running stats)
+    carry_names = [n for n in written_scan
+                   if _is_persistable(n) and n in state_in_set]
+    # last-value: persistable writes never read (rare) — final micro wins
+    last_names = [n for n in written_scan
+                  if _is_persistable(n) and n not in state_in_set]
+    # averaged: everything the once-segment consumes from the scan (grads)
+    cross_names = sorted(
+        (read_once & written_scan_set) - set(carry_names) - set(last_names))
+    fetch_scan = [n for n in block_program.fetch_names
+                  if n in written_scan_set]
+
+    def _mean_stacked(s):
+        if isinstance(s, SelectedRows):
+            # stacked sparse grads: rows [k, N], values [k, N, ...] —
+            # concat micro contributions, scale 1/k
+            rows = s.rows.reshape(-1)
+            vals = (s.values / k).reshape((-1,) + s.values.shape[2:])
+            return SelectedRows(rows, vals, s.height)
+        return jnp.mean(s, axis=0)
+
+    def fn(feed_values, state_values, rng_key):
+        base = dict(zip(state_in_names, state_values))
+        micro_feeds = []
+        for name, v in zip(feed_names, feed_values):
+            if v.shape[0] % k != 0:
+                raise ValueError(
+                    "accumulate_steps=%d does not divide feed %r batch "
+                    "dim %d" % (k, name, v.shape[0]))
+            micro_feeds.append(
+                v.reshape((k, v.shape[0] // k) + tuple(v.shape[1:])))
+
+        def micro(carry, inp):
+            feeds_t, t = inp
+            env = dict(base)
+            env.update(zip(carry_names, carry))
+            env.update(zip(feed_names, feeds_t))
+            key = jax.random.fold_in(rng_key, t)
+            with amp_scope(amp):
+                for i, op in enumerate(scan_ops):
+                    run_op(op, block, env, key, i, is_test, executor)
+            new_carry = tuple(env[n] for n in carry_names)
+            outs = (tuple(env[n] for n in cross_names),
+                    tuple(env[n] for n in last_names),
+                    tuple(env[n] for n in fetch_scan))
+            return new_carry, outs
+
+        init_carry = tuple(base[n] for n in carry_names)
+        carry_final, (cross_st, last_st, fetch_st) = jax.lax.scan(
+            micro, init_carry, (tuple(micro_feeds), jnp.arange(k)))
+
+        env = dict(base)
+        env.update(zip(carry_names, carry_final))
+        for n, s in zip(cross_names, cross_st):
+            env[n] = _mean_stacked(s)
+        for n, s in zip(last_names, last_st):
+            env[n] = jax.tree_util.tree_map(lambda a: a[-1], s)
+        with amp_scope(amp):
+            for i, op in enumerate(once_ops):
+                run_op(op, block, env, rng_key, 100_000 + i, is_test,
+                       executor)
+
+        micro_b = micro_feeds[0].shape[1] if micro_feeds else None
+        fetch_map = dict(zip(fetch_scan, fetch_st))
+        fetches = []
+        for n in block_program.fetch_names:
+            if n in fetch_map:
+                s = fetch_map[n]
+                # per-example fetches (leading dim == the micro-batch
+                # size) concat back to [k*b, ...]; everything else (loss,
+                # metrics, debug tensors) averages — the k*B equivalents
+                if (micro_b is not None and s.ndim >= 2
+                        and s.shape[1] == micro_b):
+                    fetches.append(s.reshape((-1,) + tuple(s.shape[2:])))
+                else:
+                    fetches.append(jnp.mean(s, axis=0))
+            else:
+                fetches.append(densify(env[n]))
+        state_out = [densify(env[n])
+                     for n in block_program.state_out_names]
+        return fetches, state_out
+
+    return fn

@@ -63,7 +63,12 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
-            use_program_cache=True):
+            use_program_cache=True, accumulate_steps=1):
+        """``accumulate_steps=k`` runs the feed as k micro-batches through a
+        compiled scan with one optimizer update on the averaged gradients —
+        the batch-merge capability (reference:
+        framework/ir/multi_batch_merge_pass.cc; see
+        engine/lowering.py lower_block_accumulated)."""
         from paddle_tpu.compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()
@@ -100,4 +105,5 @@ class Executor:
             return_numpy=return_numpy,
             seed=getattr(program, "random_seed", 0) or 0,
             amp=getattr(program, "_amp", False),
+            accumulate_steps=accumulate_steps,
         )

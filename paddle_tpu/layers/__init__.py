@@ -3,6 +3,8 @@ from paddle_tpu.layers.nn import *  # noqa: F401,F403
 from paddle_tpu.layers.control_flow import (  # noqa: F401
     While,
     StaticRNN,
+    DynamicRNN,
+    IfElse,
     Switch,
     create_array,
     array_write,

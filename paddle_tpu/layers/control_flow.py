@@ -376,3 +376,231 @@ def increment(x, value=1.0, in_place=True):
         attrs={"step": float(value)},
     )
     return out
+
+
+class DynamicRNN:
+    """Variable-length recurrence (reference: layers/control_flow.py
+    DynamicRNN → lod_rank_table + shrink-memory machinery). TPU-native:
+    inputs are the padded batch-major [B, T, D] + a [B] length tensor, and
+    the whole RNN lowers to ONE masked ``lax.scan`` — rows freeze their
+    state and emit zeros once t >= length, which is numerically identical
+    to the reference's shrinking-batch reordering without any data-
+    dependent shapes.
+
+    Divergence from the reference API: the sequence length is passed
+    explicitly to ``step_input`` (the reference reads it from the
+    LoDTensor's metadata, which does not exist device-side here).
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._inputs = []
+        self._memories = []
+        self._mem_updates = {}
+        self._step_outputs = []
+        self._outputs = []
+        self._sub_block = None
+        self._parent_block = None
+        self._max_len = None
+        self._length_var = None
+        self._complete = False
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        self._parent_block = program.current_block()
+        self._sub_block = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+            self._complete_op()
+
+    def step_input(self, x, length=None, level=0):
+        """x: padded [B, T, ...]; length: [B] int lengths (required on the
+        first step_input)."""
+        if x.shape is None or len(x.shape) < 2:
+            raise ValueError("DynamicRNN step_input needs [B, T, ...]")
+        if self._max_len is None:
+            self._max_len = x.shape[1]
+        if length is not None:
+            self._length_var = length
+        if self._length_var is None:
+            raise ValueError(
+                "DynamicRNN needs the sequence lengths: pass length= on "
+                "the first step_input (the padded-batch LoD equivalent)")
+        sub = self.helper.main_program.current_block()
+        ipt = sub.create_var(
+            name=unique_name.generate("drnn_input"),
+            shape=[x.shape[0]] + list(x.shape[2:]),
+            dtype=x.dtype,
+        )
+        self._inputs.append((x, ipt))
+        return ipt
+
+    def static_input(self, x):
+        """Per-sequence constant visible at every step (reference:
+        DynamicRNN.static_input). Ancestor-block reads are captured as
+        scan-invariant params automatically, so the var is used as-is."""
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32",
+               need_reorder=False):
+        from paddle_tpu.layers import tensor as tensor_layers
+
+        if init is None:
+            if shape is None or not self._inputs:
+                raise ValueError(
+                    "memory needs init= or shape= after a step_input")
+            prog = self.helper.main_program
+            cur = prog.current_block_idx
+            prog.current_block_idx = self._parent_block.idx
+            try:
+                init = tensor_layers.fill_constant_batch_size_like(
+                    input=self._inputs[0][0], shape=[-1] + list(shape),
+                    dtype=dtype, value=value)
+            finally:
+                prog.current_block_idx = cur
+        sub = self.helper.main_program.current_block()
+        mem = sub.create_var(
+            name=unique_name.generate("drnn_memory"),
+            shape=list(init.shape) if init.shape else None,
+            dtype=init.dtype,
+        )
+        self._memories.append((init, mem))
+        return mem
+
+    def update_memory(self, mem, new):
+        self._mem_updates[mem.name] = new.name
+
+    def output(self, *outputs):
+        for o in outputs:
+            self._step_outputs.append(o)
+            out = self._parent_block.create_var(
+                name=unique_name.generate("drnn_output"),
+                shape=([o.shape[0], self._max_len] + list(o.shape[1:]))
+                if o.shape is not None else None,
+                dtype=o.dtype,
+            )
+            self._outputs.append(out)
+
+    def _complete_op(self):
+        if self._complete:
+            return
+        self._complete = True
+        program = self.helper.main_program
+        sub = self._sub_block
+        parent = self._parent_block
+
+        reads, _ = _analyze_sub_block(program, sub)
+        input_names = {i.name for _, i in self._inputs}
+        mem_names = {m.name for _, m in self._memories}
+        params = [
+            n for n in reads
+            if n not in input_names and n not in mem_names
+            and n not in {x.name for x, _ in self._inputs}
+            and n not in {iv.name for iv, _ in self._memories}
+        ]
+        finals = [
+            parent.create_var(
+                name=unique_name.generate("drnn_final_state"),
+                shape=list(iv.shape) if iv.shape else None, dtype=iv.dtype)
+            for iv, _ in self._memories
+        ]
+        for _, m in self._memories:
+            if m.name not in self._mem_updates:
+                raise RuntimeError(
+                    "DynamicRNN memory %r was never update_memory()'d"
+                    % m.name)
+        parent.append_op(
+            type="recurrent",
+            inputs={
+                "Inputs": [x.name for x, _ in self._inputs],
+                "InitStates": [iv.name for iv, _ in self._memories],
+                "Params": params,
+                "SeqLen": [self._length_var.name],
+            },
+            outputs={
+                "Outputs": [o.name for o in self._outputs],
+                "FinalStates": [f.name for f in finals],
+            },
+            attrs={
+                "sub_block": sub.desc.idx,
+                "time_major": False,
+                "input_vars": [i.name for _, i in self._inputs],
+                "ex_state_vars": [m.name for _, m in self._memories],
+                "state_vars": [
+                    self._mem_updates[m.name] for _, m in self._memories
+                ],
+                "output_vars": [o.name for o in self._step_outputs],
+            },
+        )
+
+    def __call__(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return list(self._outputs)
+
+
+class IfElse:
+    """Per-row branching (reference: layers/control_flow.py IfElse:1490 →
+    conditional_block pairs with split/merge by a [B, 1] bool mask).
+
+    TPU-native: both branches trace over the FULL batch and each output
+    pair merges with a row-wise select — the XLA-friendly form of the
+    reference's split_lod_tensor/merge_lod_tensor. Identical results for
+    the per-row computations IfElse exists for; a batch-global reduction
+    inside a branch would see all rows (the reference sees only its
+    subset) — compute such reductions outside the branch.
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._outputs = {True: [], False: []}
+        self._in_branch = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._in_branch = True
+        try:
+            yield
+        finally:
+            self._in_branch = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_branch = False
+        try:
+            yield
+        finally:
+            self._in_branch = None
+
+    def input(self, x):
+        assert self._in_branch is not None, "input() only inside a block"
+        return x
+
+    def output(self, *outs):
+        assert self._in_branch is not None, "output() only inside a block"
+        self._outputs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        t_outs, f_outs = self._outputs[True], self._outputs[False]
+        if len(t_outs) != len(f_outs):
+            raise ValueError(
+                "IfElse branches declared different output counts: "
+                "%d vs %d" % (len(t_outs), len(f_outs)))
+        merged = []
+        block = self.helper.block
+        for tv, fv in zip(t_outs, f_outs):
+            out = block.create_var(
+                name=unique_name.generate("ifelse_out"),
+                shape=list(tv.shape) if tv.shape else None,
+                dtype=tv.dtype)
+            self.helper.append_op(
+                type="where",
+                inputs={"Condition": [self.cond.name], "X": [tv.name],
+                        "Y": [fv.name]},
+                outputs={"Out": [out.name]})
+            merged.append(out)
+        return merged

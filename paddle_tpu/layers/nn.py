@@ -68,6 +68,15 @@ __all__ = [
     "sequence_expand",
     "sequence_mask",
     "sequence_reverse",
+    "sequence_concat",
+    "sequence_slice",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_expand_as",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_conv",
+    "sequence_enumerate",
     "scale",
     "sum",
     "cumsum",
@@ -1028,6 +1037,125 @@ def sequence_reverse(x, length=None, name=None):
     helper.append_op(
         type="sequence_reverse", inputs=inputs, outputs={"Y": [out]}
     )
+    return out
+
+
+def sequence_concat(input, lengths=None, name=None):
+    """Per-row concat of ragged sequences (reference: layers/nn.py
+    sequence_concat → sequence_concat_op.cc). ``input`` is a list of
+    padded [B, T_k, D] tensors, ``lengths`` the matching [B] length
+    tensors; the result is left-compacted. The output's lengths are
+    elementwise sums of ``lengths`` (compute via elementwise_add)."""
+    helper = LayerHelper("sequence_concat", name=name)
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_variable_for_type_inference(dtype=xs[0].dtype)
+    inputs = {"X": list(xs)}
+    if lengths is not None:
+        inputs["Length"] = list(lengths)
+    helper.append_op(type="sequence_concat", inputs=inputs,
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row subsequence (reference: layers/nn.py sequence_slice)."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out]})
+    return out
+
+
+def sequence_first_step(input, length=None):
+    """First timestep of each sequence (reference: layers/nn.py
+    sequence_first_step = sequence_pool FIRST)."""
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None):
+    """Last valid timestep of each sequence (reference: layers/nn.py
+    sequence_last_step = sequence_pool LAST)."""
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_expand_as(x, y, name=None):
+    """Broadcast x rows along y's time dim (reference: layers/nn.py
+    sequence_expand_as)."""
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="sequence_expand_as",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Pad each row to maxlen with pad_value; returns (Out, Length)
+    (reference: layers/nn.py sequence_pad)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    len_out = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"X": [x], "PadValue": [pad_value]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="sequence_pad", inputs=inputs,
+        outputs={"Out": [out], "Length": [len_out]},
+        attrs={"padded_length": maxlen if maxlen is not None else -1})
+    return out, len_out
+
+
+def sequence_unpad(x, length, name=None):
+    """Strip pad values back to the zero-padded convention (reference:
+    layers/nn.py sequence_unpad)."""
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  length=None, name=None):
+    """Context-window convolution over time (reference: layers/nn.py
+    sequence_conv → sequence_conv_op.cc)."""
+    helper = LayerHelper("sequence_conv", name=name, act=act,
+                         bias_attr=bias_attr, param_attr=param_attr)
+    dtype = input.dtype
+    d = input.shape[-1]
+    filter_shape = [filter_size * d, num_filters]
+    filter_param = helper.create_parameter(
+        attr=param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [input], "Filter": [filter_param]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="sequence_conv", inputs=inputs, outputs={"Out": [out]},
+        attrs={"contextLength": filter_size,
+               "contextStart": -((filter_size - 1) // 2),
+               "contextStride": filter_stride})
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None,
+                       name=None):
+    """Sliding id windows (reference: layers/nn.py sequence_enumerate);
+    ``length`` bounds windows per row like the reference's LoD."""
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="sequence_enumerate", inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"win_size": win_size, "pad_value": pad_value})
     return out
 
 

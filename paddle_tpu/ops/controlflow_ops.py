@@ -56,7 +56,7 @@ register_no_grad_op("logical_xor")(_logical(jnp.logical_xor))
 register_no_grad_op("logical_not")(_logical(jnp.logical_not))
 
 
-@register_no_grad_op("where")
+@register_op("where", no_grad_inputs=("Condition",))
 def where_op(ctx, ins, attrs):
     cond = single(ins, "Condition")
     x = single(ins, "X")
@@ -170,7 +170,7 @@ def conditional_block(ctx, ins, attrs):
 # operators/recurrent_op.cc + recurrent_op gradient).
 # ---------------------------------------------------------------------------
 
-@register_op("recurrent")
+@register_op("recurrent", no_grad_inputs=("SeqLen",))
 def recurrent(ctx, ins, attrs):
     sub = _sub_block_of(ctx, attrs)
     input_vars = list(attrs.get("input_vars", []))      # sub-block names, x[t]
@@ -179,14 +179,36 @@ def recurrent(ctx, ins, attrs):
     output_vars = list(attrs.get("output_vars", []))      # per-step outputs
     param_names = list(ctx.op.inputs.get("Params", []))
     reverse = bool(attrs.get("reverse", False))
+    # batch-major (DynamicRNN): inputs/outputs are [B, T, ...]; the scan
+    # still runs time-major internally
+    time_major = bool(attrs.get("time_major", True))
 
     xs = ins.get("Inputs", [])
     init_states = ins.get("InitStates", [])
     params = ins.get("Params", [])
     base_env = dict(zip(param_names, params))
 
+    # Ragged batches (the reference's DynamicRNN shrinking-batch semantics,
+    # recurrent_op.cc + lod_rank_table.h): a [B] SeqLen freezes each row's
+    # states once t >= len and zeroes its outputs — identical results
+    # without reordering by length.
+    seq_len = ins.get("SeqLen", [None])
+    seq_len = seq_len[0] if seq_len else None
+    if seq_len is not None:
+        seq_len = seq_len.reshape(-1).astype(jnp.int32)
+
+    if not time_major:
+        xs = [jnp.moveaxis(x, 1, 0) for x in xs]
     if reverse:
+        if seq_len is not None:
+            raise NotImplementedError(
+                "recurrent: reverse with SeqLen — apply sequence_reverse "
+                "(which is length-aware) to the input instead")
         xs = [jnp.flip(x, axis=0) for x in xs]
+
+    def _row_mask(t, ref):
+        m = (t < seq_len)
+        return m.reshape((-1,) + (1,) * (ref.ndim - 1))
 
     def step(states, xt):
         xs_t, t = xt
@@ -198,6 +220,13 @@ def recurrent(ctx, ins, attrs):
         _run_sub_block(sub_ctx, sub, env)
         new_states = tuple(env[n] for n in state_vars)
         outs = tuple(env[n] for n in output_vars)
+        if seq_len is not None:
+            new_states = tuple(
+                jnp.where(_row_mask(t, new), new, old)
+                for new, old in zip(new_states, states))
+            outs = tuple(
+                jnp.where(_row_mask(t, o), o, jnp.zeros_like(o))
+                for o in outs)
         return new_states, outs
 
     T = xs[0].shape[0] if xs else int(attrs.get("max_len", 1))
@@ -207,6 +236,8 @@ def recurrent(ctx, ins, attrs):
     stacked = [
         jnp.flip(o, axis=0) if reverse else o for o in stacked
     ]
+    if not time_major:
+        stacked = [jnp.moveaxis(o, 0, 1) for o in stacked]
     return {"Outputs": list(stacked), "FinalStates": list(final_states)}
 
 

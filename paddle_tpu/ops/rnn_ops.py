@@ -36,6 +36,8 @@ def dynamic_lstm(ctx, ins, attrs):
     h0 = ins.get("H0", [None])[0]
     c0 = ins.get("C0", [None])[0]
     seq_len = ins.get("SeqLen", [None])[0]   # [B] int lengths, optional
+    if seq_len is not None:
+        seq_len = seq_len.reshape(-1)  # accept [B] or [B, 1]
 
     B, T, H4 = x.shape
     H = H4 // 4
@@ -96,6 +98,8 @@ def dynamic_gru(ctx, ins, attrs):
     bias = ins.get("Bias", [None])[0]   # [1, 3H]
     h0 = ins.get("H0", [None])[0]
     seq_len = ins.get("SeqLen", [None])[0]
+    if seq_len is not None:
+        seq_len = seq_len.reshape(-1)  # accept [B] or [B, 1]
 
     B, T, H3 = x.shape
     H = H3 // 3

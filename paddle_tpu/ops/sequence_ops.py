@@ -114,3 +114,161 @@ def im2sequence(ctx, ins, attrs):
     out = jnp.stack(patches, axis=-1).reshape(n, c, oh * ow, kh * kw)
     out = out.transpose(0, 2, 1, 3).reshape(n * oh * ow, c * kh * kw)
     return {"Out": [out]}
+
+
+@register_op("sequence_concat", no_grad_inputs=("Length",))
+def sequence_concat(ctx, ins, attrs):
+    """Per-row concatenation of ragged sequences (reference:
+    sequence_ops/sequence_concat_op.cc): row i of the output is
+    x1[i, :l1[i]] ++ x2[i, :l2[i]] ++ ..., left-compacted into a padded
+    [B, sum(T_k), D] tensor; padding positions are zero."""
+    xs = ins.get("X", [])
+    lens = ins.get("Length", [])
+    if not lens:
+        # no lengths: every row is full (plain dense concat along time)
+        lens = [jnp.full((x.shape[0],), x.shape[1], jnp.int32) for x in xs]
+    if len(xs) != len(lens):
+        raise ValueError(
+            "sequence_concat needs one Length per input (got %d inputs, "
+            "%d lengths)" % (len(xs), len(lens)))
+    b = xs[0].shape[0]
+    t_out = sum(x.shape[1] for x in xs)
+    out = jnp.zeros((b,) + (t_out,) + tuple(xs[0].shape[2:]), xs[0].dtype)
+    pos = jnp.arange(t_out)[None, :]                       # [1, T_out]
+    start = jnp.zeros((b, 1), jnp.int32)
+    for x, l in zip(xs, lens):
+        l = l.reshape(-1, 1).astype(jnp.int32)             # [B, 1]
+        # positions [start, start+l) take x[., pos-start]
+        in_seg = (pos >= start) & (pos < start + l)
+        src = jnp.clip(pos - start, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, src.reshape(b, t_out, *([1] * (x.ndim - 2))), axis=1)
+        mask = in_seg.reshape(b, t_out, *([1] * (x.ndim - 2)))
+        out = jnp.where(mask, gathered, out)
+        start = start + l
+    return {"Out": [out]}
+
+
+@register_op("sequence_slice", no_grad_inputs=("Offset", "Length"))
+def sequence_slice(ctx, ins, attrs):
+    """Per-row subsequence [offset, offset+length) left-compacted to the
+    front of a same-T padded tensor (reference:
+    sequence_ops/sequence_slice_op.cc)."""
+    x = single(ins, "X")                                   # [B, T, ...]
+    offset = single(ins, "Offset").reshape(-1, 1).astype(jnp.int32)
+    length = single(ins, "Length").reshape(-1, 1).astype(jnp.int32)
+    b, t = x.shape[0], x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.clip(pos + offset, 0, t - 1)
+    gathered = jnp.take_along_axis(
+        x, src.reshape(b, t, *([1] * (x.ndim - 2))), axis=1)
+    mask = (pos < length).reshape(b, t, *([1] * (x.ndim - 2)))
+    return {"Out": [jnp.where(mask, gathered, 0)]}
+
+
+@register_op("sequence_expand_as", no_grad_inputs=("Y",))
+def sequence_expand_as(ctx, ins, attrs):
+    """x [B, D] broadcast along y's time dim (reference:
+    sequence_ops/sequence_expand_as_op.cc — each row repeated to its
+    target sequence's length; padding handled by downstream masks)."""
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    t = y.shape[1]
+    return {"Out": [jnp.broadcast_to(
+        x[:, None], (x.shape[0], t) + tuple(x.shape[1:]))]}
+
+
+@register_op("sequence_pad", no_grad_inputs=("Length", "PadValue"))
+def sequence_pad(ctx, ins, attrs):
+    """Pad/repad to padded_length with PadValue beyond each row's length;
+    also emits the length tensor (reference:
+    sequence_ops/sequence_pad_op.cc outputs Out + Length)."""
+    x = single(ins, "X")                                   # [B, T, ...]
+    lengths = single(ins, "Length").reshape(-1)
+    pad_value = single(ins, "PadValue")
+    padded_length = int(attrs.get("padded_length", -1))
+    t = x.shape[1]
+    if padded_length < 0:
+        padded_length = t
+    if padded_length > t:
+        pad = [(0, 0), (0, padded_length - t)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad)
+    else:
+        x = x[:, :padded_length]
+    mask = (jnp.arange(padded_length)[None, :]
+            < lengths[:, None]).reshape(
+        x.shape[0], padded_length, *([1] * (x.ndim - 2)))
+    out = jnp.where(mask, x, jnp.reshape(pad_value, ()).astype(x.dtype))
+    # rows longer than padded_length are truncated — the emitted Length
+    # must agree with the tensor (the reference instead enforces
+    # padded_length >= max len; clamping keeps downstream masks in range)
+    return {"Out": [out],
+            "Length": [jnp.minimum(lengths, padded_length).astype(
+                jnp.int64)]}
+
+
+@register_op("sequence_unpad", no_grad_inputs=("Length",))
+def sequence_unpad(ctx, ins, attrs):
+    """Inverse of sequence_pad: strip pad values back to the zero-padded
+    ragged convention (reference: sequence_ops/sequence_unpad_op.cc —
+    true ragged output; here the compact form IS padded-with-zeros)."""
+    x = single(ins, "X")
+    lengths = single(ins, "Length").reshape(-1)
+    mask = (jnp.arange(x.shape[1])[None, :] < lengths[:, None]).reshape(
+        x.shape[0], x.shape[1], *([1] * (x.ndim - 2)))
+    return {"Out": [jnp.where(mask, x, 0)]}
+
+
+@register_op("sequence_conv", no_grad_inputs=("Length",))
+def sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over time (reference:
+    sequence_ops/sequence_conv_op.cc + math/context_project.h): the
+    context window [start, start+len) around each step is flattened to
+    [B, T, ctx*D] and matmul'd with Filter [ctx*D, F]. Out-of-range and
+    beyond-length context positions contribute zeros."""
+    x = single(ins, "X")                                   # [B, T, D]
+    lengths = single(ins, "Length").reshape(-1)
+    filt = single(ins, "Filter")                           # [ctx*D, F]
+    ctx_len = int(attrs.get("contextLength"))
+    ctx_start = int(attrs.get("contextStart", -((ctx_len - 1) // 2)))
+    b, t, d = x.shape
+    step_mask = (jnp.arange(t)[None, :] < lengths[:, None])  # [B, T]
+    xz = jnp.where(step_mask[..., None], x, 0)
+    cols = []
+    for k in range(ctx_len):
+        shift = ctx_start + k
+        rolled = jnp.roll(xz, -shift, axis=1)
+        pos = jnp.arange(t) + shift
+        valid = (pos >= 0)[None, :] & (pos[None, :] < lengths[:, None])
+        cols.append(jnp.where(valid[..., None], rolled, 0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)               # [B, T, ctx*D]
+    out = jnp.einsum("btc,cf->btf", ctx_mat, filt)
+    out = jnp.where(step_mask[..., None], out, 0)
+    return {"Out": [out]}
+
+
+@register_op("sequence_enumerate", grad=None,
+             no_grad_inputs=("X", "Length"))
+def sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of ids (reference:
+    sequence_ops/sequence_enumerate_op.cc): [B, T] int ids -> [B, T, win]
+    where out[b, t] = ids[b, t:t+win], pad_value past each row's end.
+    With a Length input the windows are bounded per row, like the
+    reference's LoD-bounded enumerate — without it, padding positions of
+    shorter rows would leak id 0 into windows."""
+    x = single(ins, "X")
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    win = int(attrs.get("win_size"))
+    pad_value = attrs.get("pad_value", 0)
+    t = x.shape[-1]
+    lengths = ins.get("Length", [None])
+    lengths = lengths[0] if lengths else None
+    bound = (lengths.reshape(-1, 1).astype(jnp.int32)
+             if lengths is not None else t)
+    cols = []
+    for k in range(win):
+        pos = jnp.arange(t)[None, :] + k
+        shifted = jnp.roll(x, -k, axis=-1)
+        cols.append(jnp.where(pos < bound, shifted, pad_value))
+    return {"Out": [jnp.stack(cols, axis=-1)]}

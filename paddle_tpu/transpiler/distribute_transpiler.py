@@ -316,11 +316,13 @@ class DistributeTranspiler:
         dst_block = pserver.desc.global_block()
         owned = [p for p, ep in self._param_to_ep.items() if ep == endpoint]
         opt_blocks = []
+        block_grads = []   # grad var consumed by each block (async routing)
         for pname in owned:
             ops = self._ops_for_param(pname)
             sub = pserver.desc.append_block(0)
             _clone_ops_into(sub, ops, src_block, dst_block)
             opt_blocks.append(sub.idx)
+            block_grads.append(pname + "@GRAD")
 
         # Distributed lookup tables: every pserver owns one row-shard of
         # every table. The optimizer sub-block is the ORIGINAL optimizer op
@@ -360,11 +362,13 @@ class DistributeTranspiler:
                 "sliced": sorted(sliced),
             })
             opt_blocks.append(sub.idx)
+            block_grads.append(wname + "@GRAD")
 
         dst_block.ops.append(_marker_op(
             "listen_and_serv", {}, {},
             {"endpoint": endpoint,
              "optimize_blocks": opt_blocks,
+             "block_grads": block_grads,
              "Fanin": self.trainer_num,
              "sync_mode": self.sync_mode,
              "dist_tables": dist_tables_attr,

@@ -206,3 +206,28 @@ class TestProfiler:
         with fluid.profiler.record_event("unit-test-span"):
             x = np.ones(4).sum()
         assert x == 4
+
+
+def test_check_nan_inf_guard(monkeypatch):
+    """PADDLE_TPU_CHECK_NAN_INF raises naming the poisoned tensor
+    (reference: FLAGS_check_nan_inf, framework/operator.cc:972)."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        loss = fluid.layers.mean(fluid.layers.log(h))  # log of negatives
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.engine.check_nan_inf = True
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="check_nan_inf"):
+            exe.run(main, feed={"x": -np.ones((8, 4), np.float32)},
+                    fetch_list=[loss])

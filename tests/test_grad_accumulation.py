@@ -1,0 +1,134 @@
+"""Gradient accumulation (batch-merge): k micro-batches through a compiled
+scan + one update on averaged grads must EXACTLY match one k*B batch
+(reference: framework/ir/multi_batch_merge_pass.cc +
+tests/unittests/dist_mnist_batch_merge.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _build(optimizer, with_bn=False, with_clip=False):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="w1"))
+        if with_bn:
+            h = fluid.layers.batch_norm(h)
+        pred = fluid.layers.fc(input=h, size=4,
+                               param_attr=fluid.ParamAttr(name="w2"))
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        if with_clip:
+            fluid.clip.set_gradient_clip(
+                fluid.clip.GradientClipByGlobalNorm(0.01))
+        if optimizer == "adam":
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        else:
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        fluid.clip.set_gradient_clip(None)
+    return main, startup, loss
+
+
+def _train(optimizer, accumulate_steps, with_bn=False, with_clip=False,
+           steps=4, batch=32):
+    main, startup, loss = _build(optimizer, with_bn, with_clip)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set("w1", np.linspace(-0.5, 0.5, 12 * 16).astype(
+            np.float32).reshape(12, 16))
+        scope.set("w2", np.linspace(0.3, -0.3, 16 * 4).astype(
+            np.float32).reshape(16, 4))
+        losses = []
+        for _ in range(steps):
+            xv = rng.randn(batch, 12).astype(np.float32)
+            yv = rng.randint(0, 4, (batch, 1)).astype(np.int64)
+            (l,) = exe.run(main, feed={"x": xv, "y": yv},
+                           fetch_list=[loss],
+                           accumulate_steps=accumulate_steps)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        params = {n: np.asarray(jax.device_get(scope.get(n)))
+                  for n in ("w1", "w2")}
+    return losses, params
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_accumulation_matches_big_batch(optimizer):
+    l1, p1 = _train(optimizer, accumulate_steps=1)
+    l4, p4 = _train(optimizer, accumulate_steps=4)
+    np.testing.assert_allclose(l4, l1, rtol=1e-5, atol=1e-6)
+    for n in p1:
+        np.testing.assert_allclose(p4[n], p1[n], rtol=1e-4, atol=1e-6)
+
+
+def test_accumulation_with_global_norm_clip():
+    """Clipping sees the AVERAGED grads, so k-step accumulation still
+    matches the big batch exactly."""
+    l1, p1 = _train("sgd", 1, with_clip=True)
+    l4, p4 = _train("sgd", 4, with_clip=True)
+    np.testing.assert_allclose(l4, l1, rtol=1e-5, atol=1e-6)
+    for n in p1:
+        np.testing.assert_allclose(p4[n], p1[n], rtol=1e-4, atol=1e-6)
+
+
+def test_accumulation_bn_stats_update_sequentially():
+    """BN running stats inside the scan update once per micro-batch (the
+    k-real-steps semantics); training still converges."""
+    losses, _ = _train("sgd", 4, with_bn=True, steps=30)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_accumulation_sparse_embedding():
+    """Sparse SelectedRows grads accumulate across micro-batches (concat
+    rows, 1/k scale) and match the big batch."""
+
+    def build():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            ids = fluid.layers.data(name="ids", shape=[3], dtype="int64")
+            emb = fluid.layers.embedding(
+                ids, size=[50, 4], is_sparse=True,
+                param_attr=fluid.ParamAttr(name="acc_emb"))
+            loss = fluid.layers.mean(fluid.layers.square(emb))
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        return main, startup, loss
+
+    def train(k):
+        main, startup, loss = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(1)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            scope.set("acc_emb", np.linspace(-1, 1, 200).astype(
+                np.float32).reshape(50, 4))
+            for _ in range(3):
+                ids = rng.randint(0, 50, (8, 3)).astype(np.int64)
+                exe.run(main, feed={"ids": ids}, fetch_list=[loss],
+                        accumulate_steps=k)
+            return np.asarray(jax.device_get(scope.get("acc_emb")))
+
+    np.testing.assert_allclose(train(4), train(1), rtol=1e-5, atol=1e-6)
+
+
+def test_accumulation_rejects_indivisible_batch():
+    main, startup, loss = _build("sgd")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="does not divide"):
+            exe.run(main,
+                    feed={"x": np.zeros((10, 12), np.float32),
+                          "y": np.zeros((10, 1), np.int64)},
+                    fetch_list=[loss], accumulate_steps=3)
