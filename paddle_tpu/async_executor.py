@@ -1,0 +1,148 @@
+"""AsyncExecutor: multi-threaded file-fed CPU training — the CTR
+production path (reference: python/paddle/fluid/async_executor.py +
+framework/async_executor.h:60 + framework/data_feed.h:49
+MultiSlotDataFeed + hogwild worker threads).
+
+TPU-native redesign: each worker thread parses its share of the filelist
+with MultiSlotDataFeed and drives the SAME compiled XLA step over a
+SHARED scope — Hogwild semantics (no locks between workers; concurrent
+updates may overwrite each other, which is the reference's lock-free
+contract). Buffer donation is disabled for these runs so two in-flight
+steps never alias a donated parameter buffer.
+
+Data format (reference MultiSlotDataFeed): each text line holds, per
+slot, ``<count> v1 ... v_count``. Sparse slots become padded id arrays
+(+ ``<name>@LEN`` lengths when the program declares them); dense slots
+must have a fixed count per line.
+"""
+
+import threading
+
+import numpy as np
+
+from paddle_tpu.data_feeder import LENGTH_SUFFIX, bucketed_length
+
+__all__ = ["AsyncExecutor"]
+
+
+def _parse_line(line, slots):
+    vals = line.split()
+    out = []
+    i = 0
+    for s in slots:
+        n = int(vals[i])
+        i += 1
+        conv = float if s.type.startswith("float") else int
+        out.append([conv(v) for v in vals[i:i + n]])
+        i += n
+    return out
+
+
+def _make_batch(rows, slots, program):
+    """rows: list of per-slot value lists (ALL slots, parse order) ->
+    feed dict of the USED slots (padded + @LEN), like the reference's
+    MultiSlotDataFeed which parses every slot and discards unused ones."""
+    block = program.global_block()
+    feed = {}
+    for si, s in enumerate(slots):
+        if not s.is_used:
+            continue
+        col = [r[si] for r in rows]
+        np_t = np.float32 if s.type.startswith("float") else np.int64
+        if s.is_dense:
+            feed[s.name] = np.asarray(col, np_t)
+            continue
+        maxlen = bucketed_length(max(len(c) for c in col))
+        batch = np.zeros((len(col), maxlen), np_t)
+        for i, c in enumerate(col):
+            batch[i, :len(c)] = c
+        feed[s.name] = batch
+        if block.desc.find_var_recursive(s.name + LENGTH_SUFFIX) is not None:
+            feed[s.name + LENGTH_SUFFIX] = np.asarray(
+                [len(c) for c in col], np.int64)
+    return feed
+
+
+class AsyncExecutor:
+    """(reference: async_executor.py:33)"""
+
+    def __init__(self, place=None, run_mode=""):
+        import paddle_tpu.fluid as fluid
+
+        self.place = place
+        self.executor = fluid.Executor(place)
+        self.scope = fluid.global_scope()
+
+    def run_startup_program(self, program, scope=None):
+        self.executor.run(program, scope=scope or self.scope)
+
+    def run(self, program, data_feed, filelist, thread_num, fetch,
+            mode="", debug=False, scope=None):
+        """Train over ``filelist`` with ``thread_num`` hogwild workers;
+        returns per-fetch means over every batch of every thread
+        (reference prints these in debug mode, async_executor.py:150)."""
+        scope = scope or self.scope
+        # parse EVERY declared slot (lines contain all of them); unused
+        # slots are dropped at batch-build time
+        slots = data_feed.slots
+        fetch_names = [f.name if hasattr(f, "name") else str(f)
+                       for f in (fetch or [])]
+        batch_size = data_feed.batch_size
+        thread_num = max(1, min(thread_num, len(filelist)))
+        results = [None] * thread_num
+        errors = []
+
+        def worker(tid):
+            try:
+                sums = np.zeros(len(fetch_names))
+                count = 0
+                for fname in filelist[tid::thread_num]:
+                    rows = []
+                    with open(fname) as f:
+                        for line in f:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            rows.append(_parse_line(line, slots))
+                            if len(rows) == batch_size:
+                                count += 1
+                                sums += self._step(program, scope, slots,
+                                                   rows, fetch_names)
+                                rows = []
+                    if rows:
+                        count += 1
+                        sums += self._step(program, scope, slots, rows,
+                                           fetch_names)
+                results[tid] = (sums, count)
+            except Exception as e:  # propagate to the caller
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(thread_num)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        total = np.zeros(len(fetch_names))
+        n = 0
+        for sums, count in results:
+            total += sums
+            n += count
+        if debug:
+            for name, v in zip(fetch_names, total / max(n, 1)):
+                print("AsyncExecutor %s = %f" % (name, v))
+        return list(total / max(n, 1))
+
+    def _step(self, program, scope, slots, rows, fetch_names):
+        feed = _make_batch(rows, slots, program)
+        outs = self.executor.engine.run_block(
+            program.desc, 0, scope, feed=feed, fetch_list=fetch_names,
+            is_test=getattr(program, "_is_test", False),
+            # Hogwild: two in-flight steps must not alias donated buffers
+            donate_state=False,
+            seed=getattr(program, "random_seed", 0) or 0,
+            amp=getattr(program, "_amp", False))
+        return np.asarray([float(np.asarray(o).reshape(-1)[0])
+                           for o in outs])

@@ -1,0 +1,324 @@
+"""Reference-format importers: binary ProgramDesc protobufs and saved
+tensors.
+
+The reference serializes programs with protobuf (reference:
+paddle/fluid/framework/framework.proto — ProgramDesc/BlockDesc/VarDesc/
+OpDesc messages) and parameters with a versioned tensor stream
+(reference: paddle/fluid/framework/lod_tensor.cc SerializeToStream +
+tensor_util.cc TensorToStream). This module reads BOTH without a
+protobuf dependency: a minimal proto2 wire-format decoder driven by the
+schema's field numbers, so a reference `save_inference_model` directory
+(`__model__` + per-var files) loads directly for cross-checking.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.core.desc import (BlockDescData, OpDesc, ProgramDescData,
+                                  VarDescData)
+from paddle_tpu.core.types import VarType
+
+__all__ = ["parse_program_desc", "load_reference_program",
+           "load_reference_inference_model", "load_reference_var"]
+
+
+# -- protobuf wire-format primitives ---------------------------------------
+
+def _read_varint(buf, off):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over a message's bytes."""
+    off = 0
+    n = len(buf)
+    while off < n:
+        key, off = _read_varint(buf, off)
+        field, wt = key >> 3, key & 7
+        if wt == 0:                      # varint
+            val, off = _read_varint(buf, off)
+        elif wt == 1:                    # 64-bit
+            val = buf[off:off + 8]
+            off += 8
+        elif wt == 2:                    # length-delimited
+            ln, off = _read_varint(buf, off)
+            val = buf[off:off + ln]
+            off += ln
+        elif wt == 5:                    # 32-bit
+            val = buf[off:off + 4]
+            off += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        yield field, wt, val
+
+
+def _group(buf):
+    out = {}
+    for field, wt, val in _fields(buf):
+        out.setdefault(field, []).append((wt, val))
+    return out
+
+
+def _f32(val):
+    return struct.unpack("<f", val)[0]
+
+
+def _i64(v):
+    # proto int64 varints are two's complement in 64 bits
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _packed_varints(entries):
+    out = []
+    for wt, val in entries:
+        if wt == 0:
+            out.append(val)
+        else:                            # packed
+            off = 0
+            while off < len(val):
+                v, off = _read_varint(val, off)
+                out.append(v)
+    return out
+
+
+def _packed_floats(entries):
+    out = []
+    for wt, val in entries:
+        if wt == 5:
+            out.append(_f32(val))
+        else:
+            out.extend(struct.unpack("<%df" % (len(val) // 4), val))
+    return out
+
+
+# -- framework.proto decoding ----------------------------------------------
+
+# OpDesc.Attr fields (framework.proto:44-59)
+_ATTR_DECODERS = {
+    0: lambda g: _sint32(_one(g, 3)),                 # INT
+    1: lambda g: _f32_field(g),                       # FLOAT
+    2: lambda g: _one(g, 5).decode("utf-8"),          # STRING
+    3: lambda g: [_sint32(v) for v in _packed_varints(g.get(6, []))],
+    4: lambda g: _packed_floats(g.get(7, [])),        # FLOATS
+    5: lambda g: [v.decode("utf-8") for _, v in g.get(8, [])],
+    6: lambda g: bool(_one(g, 10)),                   # BOOLEAN
+    7: lambda g: [bool(v) for v in _packed_varints(g.get(11, []))],
+    8: lambda g: _sint32(_one(g, 12)),                # BLOCK (block_idx)
+    9: lambda g: _i64(_one(g, 13)),                   # LONG
+    10: lambda g: [_sint32(v) for v in _packed_varints(g.get(14, []))],
+    11: lambda g: [_i64(v) for v in _packed_varints(g.get(15, []))],
+}
+
+
+def _one(g, field, default=None):
+    vals = g.get(field)
+    return vals[0][1] if vals else default
+
+
+def _sint32(v):
+    if v is None:
+        return None
+    v = int(v)
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _f32_field(g):
+    v = _one(g, 4)
+    return _f32(v) if isinstance(v, (bytes, bytearray)) else float(v)
+
+
+def _decode_attr(buf):
+    g = _group(buf)
+    name = _one(g, 1).decode("utf-8")
+    atype = int(_one(g, 2))
+    dec = _ATTR_DECODERS.get(atype)
+    if dec is None:
+        raise ValueError("unsupported attr type %d for %r" % (atype, name))
+    value = dec(g)
+    # BLOCK attrs reference sub-blocks by index — keep the int; our engine
+    # looks sub-blocks up by the same "sub_block" attr name
+    return name, value
+
+
+def _decode_op(buf):
+    g = _group(buf)
+    op_type = _one(g, 3).decode("utf-8")
+
+    def slots(field):
+        out = {}
+        for _, var_buf in g.get(field, []):
+            vg = _group(var_buf)
+            slot = _one(vg, 1).decode("utf-8")
+            out[slot] = [v.decode("utf-8") for _, v in vg.get(2, [])]
+        return out
+
+    attrs = {}
+    for _, attr_buf in g.get(4, []):
+        name, value = _decode_attr(attr_buf)
+        attrs[name] = value
+    return OpDesc(op_type, slots(1), slots(2), attrs)
+
+
+def _decode_tensor_desc(buf):
+    g = _group(buf)
+    dtype = VarType(int(_one(g, 1)))
+    dims = [_i64(v) for v in _packed_varints(g.get(2, []))]
+    return dtype, dims
+
+
+def _decode_var(buf):
+    g = _group(buf)
+    name = _one(g, 1).decode("utf-8")
+    persistable = bool(_one(g, 3, 0))
+    tg = _group(_one(g, 2))              # VarType message
+    vtype = VarType(int(_one(tg, 1)))
+    dtype, shape, lod_level = None, None, 0
+    tensor_field = {VarType.SELECTED_ROWS: 2, VarType.LOD_TENSOR: 3,
+                    VarType.LOD_TENSOR_ARRAY: 4}.get(vtype)
+    if tensor_field is not None and _one(tg, tensor_field) is not None:
+        sub = _group(_one(tg, tensor_field))
+        if vtype == VarType.SELECTED_ROWS:
+            dtype, shape = _decode_tensor_desc(_one(tg, tensor_field))
+        else:
+            dtype, shape = _decode_tensor_desc(_one(sub, 1))
+            lod_level = int(_one(sub, 2, 0))
+    vd = VarDescData(
+        name,
+        shape=[(-1 if d == -1 else int(d)) for d in (shape or [])] or None,
+        dtype=dtype if dtype is not None else VarType.FP32,
+        type=vtype,
+        persistable=persistable,
+        lod_level=lod_level,
+    )
+    return vd
+
+
+def parse_program_desc(data):
+    """Binary framework.proto ProgramDesc -> ProgramDescData."""
+    g = _group(data)
+    prog = ProgramDescData.__new__(ProgramDescData)
+    prog.version = 0
+    ver = _one(g, 2)
+    if ver is not None:
+        prog.version = int(_one(_group(ver), 1, 0))
+    prog.blocks = []
+    for _, block_buf in g.get(1, []):
+        bg = _group(block_buf)
+        b = BlockDescData(prog, int(_one(bg, 1, 0)),
+                          _sint32(_one(bg, 2, 0)))
+        b.forward_block_idx = _sint32(_one(bg, 5, -1))
+        for _, var_buf in bg.get(3, []):
+            vd = _decode_var(var_buf)
+            b.vars[vd.name] = vd
+        b.ops = [_decode_op(op_buf) for _, op_buf in bg.get(4, [])]
+        prog.blocks.append(b)
+    prog.blocks.sort(key=lambda b: b.idx)
+    return prog
+
+
+def load_reference_program(path_or_bytes):
+    """Load a reference-serialized program (`__model__` file) as a
+    paddle_tpu Program."""
+    from paddle_tpu.framework import Block, Program, Variable
+
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    desc = parse_program_desc(data)
+    program = Program()
+    program.desc = desc
+    desc._version_token = 1
+    program.blocks = [Block.__new__(Block) for _ in desc.blocks]
+    for i, b in enumerate(program.blocks):
+        b.program = program
+        b.desc = desc.block(i)
+        b.idx = i
+        b.ops = []
+        b.vars = {}
+        for name, vd in b.desc.vars.items():
+            v = Variable.__new__(Variable)
+            v.block = b
+            v.desc = vd
+            b.vars[name] = v
+    program._bump_version()
+    return program
+
+
+# -- reference tensor stream -----------------------------------------------
+
+def load_reference_var(path):
+    """One variable saved by the reference's save op (reference:
+    lod_tensor.cc SerializeToStream: uint32 version, lod levels, then
+    tensor_util.cc TensorToStream: uint32 version, int32 proto size,
+    TensorDesc proto, raw data)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    (version,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if version != 0:
+        raise ValueError("unsupported tensor stream version %d" % version)
+    (lod_level,) = struct.unpack_from("<Q", data, off)
+    off += 8
+    for _ in range(lod_level):
+        (nbytes,) = struct.unpack_from("<Q", data, off)
+        off += 8 + nbytes
+    (tversion,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if tversion != 0:
+        raise ValueError("unsupported tensor version %d" % tversion)
+    (psize,) = struct.unpack_from("<i", data, off)
+    off += 4
+    dtype, dims = _decode_tensor_desc(data[off:off + psize])
+    off += psize
+    from paddle_tpu.core.types import convert_dtype_to_np
+
+    np_dtype = convert_dtype_to_np(dtype)
+    count = int(np.prod(dims)) if dims else 1
+    arr = np.frombuffer(
+        data, dtype=np_dtype, count=count, offset=off).reshape(dims)
+    return arr.copy()
+
+
+def load_reference_inference_model(dirname, executor, scope=None,
+                                   model_filename="__model__"):
+    """Load a reference save_inference_model directory: the protobuf
+    program plus every persistable var from its same-named file
+    (reference: io.py load_inference_model + load_persistables). Returns
+    (program, feed_names, fetch_vars) like fluid.io.load_inference_model;
+    feed/fetch are recovered from the program's feed/fetch ops."""
+    from paddle_tpu.executor import global_scope
+
+    scope = scope if scope is not None else global_scope()
+    program = load_reference_program(os.path.join(dirname, model_filename))
+    gb = program.desc.global_block()
+    feed_names, fetch_names = [], []
+    for op in gb.ops:
+        if op.type == "feed":
+            feed_names.append(op.outputs["Out"][0])
+        elif op.type == "fetch":
+            fetch_names.append(op.inputs["X"][0])
+    for name, vd in gb.vars.items():
+        if not vd.persistable or vd.type not in (
+                VarType.LOD_TENSOR, VarType.SELECTED_ROWS):
+            continue
+        if name in ("feed", "fetch"):
+            continue
+        path = os.path.join(dirname, name)
+        if os.path.exists(path):
+            scope.set(name, load_reference_var(path))
+    program._is_test = True
+    fetch_vars = [program.global_block().vars[n] for n in fetch_names]
+    return program, feed_names, fetch_vars

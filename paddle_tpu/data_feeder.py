@@ -49,8 +49,17 @@ class DataFeeder:
         self.place = place
 
     def _has_length_var(self, name):
-        block = self.program.global_block()
-        return block.desc.find_var_recursive(name + LENGTH_SUFFIX) is not None
+        # fixed per feed var; memoized (the recursive block lookup is on
+        # the per-batch hot path)
+        cache = getattr(self, "_len_var_cache", None)
+        if cache is None:
+            cache = self._len_var_cache = {}
+        if name not in cache:
+            block = self.program.global_block()
+            cache[name] = (
+                block.desc.find_var_recursive(name + LENGTH_SUFFIX)
+                is not None)
+        return cache[name]
 
     def feed(self, iterable):
         """iterable: list of rows, each row a tuple matching feed_list."""

@@ -42,6 +42,8 @@ from paddle_tpu.layers.control_flow import (  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from paddle_tpu.backward import append_backward, calc_gradient  # noqa: F401
 from paddle_tpu.data_feeder import DataFeeder  # noqa: F401
+from paddle_tpu.data_feed_desc import DataFeedDesc  # noqa: F401
+from paddle_tpu.async_executor import AsyncExecutor  # noqa: F401
 from paddle_tpu.compiler import CompiledProgram  # noqa: F401
 from paddle_tpu.parallel_executor import (  # noqa: F401
     ParallelExecutor,

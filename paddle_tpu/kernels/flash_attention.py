@@ -1,14 +1,19 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels, forward AND backward.
 
-Streams K/V blocks from VMEM against a resident Q block with online-softmax
-accumulation — O(T) memory, MXU-shaped contractions (the kernel the
-reference implements as math/softmax.cu + matmuls, fused here instead).
+Forward streams K/V blocks from VMEM against a resident Q block with
+online-softmax accumulation and emits the per-row logsumexp — O(T) memory,
+MXU-shaped contractions (the kernel the reference implements as
+math/softmax.cu + matmuls, fused here instead).
+
+Backward is the FlashAttention-2 decomposition: a cheap XLA delta
+precompute (rowsum(dO*O)), a dQ kernel (Q block resident, K/V streamed)
+and a dK/dV kernel (K/V block resident, Q streamed), all re-deriving the
+softmax from the saved logsumexp instead of materializing the [T, T]
+probability matrix. The plain-XLA recompute path remains the fallback
+(PADDLE_TPU_FLASH_BWD=xla, or shapes the kernels cannot tile).
 
 ``fused_attention`` is the dispatch point: the Pallas kernel on TPU (or in
-interpreter mode for tests), the plain-XLA composition elsewhere. The
-backward pass recomputes attention in XLA (flash-style backward kernel is a
-follow-up; recompute keeps training memory at O(T) like jax.checkpoint
-would).
+interpreter mode for tests), the plain-XLA composition elsewhere.
 """
 
 import functools
@@ -27,8 +32,8 @@ except ImportError:  # pragma: no cover
 _NEG = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                 block_q):
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
+                 scale, block_q):
     q = q_ref[0].astype(jnp.float32)  # [block_q, D]
     j = pl.program_id(1)
     T = k_ref.shape[1]
@@ -71,6 +76,9 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
         nk_eff = nk
     acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # logsumexp per row, the softmax residual the backward kernels re-derive
+    # p from (FlashAttention-2's L)
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(block_q)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -85,19 +93,172 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kernel = functools.partial(
         _attn_kernel, block_k=block_k, causal=causal, scale=scale,
         block_q=block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(qr.shape, q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j: (b, j)),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, H, T, D)
+    return out.reshape(B, H, T, D), lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k, causal, scale, block_q):
+    q = q_ref[0].astype(jnp.float32)          # [block_q, D]
+    do = do_ref[0].astype(jnp.float32)        # [block_q, D]
+    lse = lse_ref[0].reshape(block_q, 1)      # [block_q, 1]
+    delta = delta_ref[0].reshape(block_q, 1)  # [block_q, 1]
+    j = pl.program_id(1)
+    T = k_ref.shape[1]
+    nk = T // block_k
+    q_pos = j * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(s, dq):
+        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+        sij = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = s * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+        p = jnp.exp(sij - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        nk_eff = jnp.minimum(
+            nk, (j + 1) * block_q // block_k + (1 if block_q % block_k else 0))
+        nk_eff = jnp.maximum(nk_eff, 1)
+    else:
+        nk_eff = nk
+    dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    dq = jax.lax.fori_loop(0, nk_eff, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_k, causal, scale, block_q):
+    k_blk = k_ref[0].astype(jnp.float32)       # [block_k, D]
+    v_blk = v_ref[0].astype(jnp.float32)       # [block_k, D]
+    s_idx = pl.program_id(1)
+    T = q_ref.shape[1]
+    nq = T // block_q
+    k_pos = s_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q)].reshape(block_q, 1)
+        delta = delta_ref[0, pl.ds(j * block_q, block_q)].reshape(
+            block_q, 1)
+        sij = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+        p = jnp.exp(sij - lse)                 # [block_q, block_k]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q blocks strictly before this k block's first row see none of it
+        j0 = (s_idx * block_k) // block_q
+    else:
+        j0 = 0
+    dk0 = jnp.zeros((block_k, k_ref.shape[2]), jnp.float32)
+    dv0 = jnp.zeros((block_k, v_ref.shape[2]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(j0, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    B, H, T, D = q.shape
+    qr, kr, vr = (x.reshape(B * H, T, D) for x in (q, k, v))
+    do = g.reshape(B * H, T, D)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.reshape(B * H, T, D).astype(
+            jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale, block_q=block_q),
+        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j: (b, j)),
+            pl.BlockSpec((1, block_q), lambda b, j: (b, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_k=block_k, causal=causal,
+                          scale=scale, block_q=block_q),
+        out_shape=[
+            jax.ShapeDtypeStruct(kr.shape, k.dtype),
+            jax.ShapeDtypeStruct(vr.shape, v.dtype),
+        ],
+        grid=(B * H, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda b, s: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, T, D), lambda b, s: (b, 0, 0)),
+            pl.BlockSpec((1, T), lambda b, s: (b, 0)),
+            pl.BlockSpec((1, T), lambda b, s: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+
+    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
+            dv.reshape(B, H, T, D))
 
 
 def _xla_attention(q, k, v, causal, scale):
@@ -116,23 +277,38 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     """[B, H, T, D] attention via the Pallas kernel; T must divide by the
     block sizes (clamped to T)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _use_xla_bwd():
+    import os
+
+    return os.environ.get("PADDLE_TPU_FLASH_BWD", "") == "xla"
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, scale_),
-        q, k, v)
-    return vjp(g)
+    T = q.shape[2]
+    bq, bk = min(block_q, T), min(block_k, T)
+    if _use_xla_bwd() or T % bq or T % bk:
+        # fallback: recompute attention in XLA (O(T^2) intermediates but
+        # always correct for odd shapes)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, scale_),
+            q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale_, bq, bk,
+                           interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

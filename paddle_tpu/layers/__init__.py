@@ -20,6 +20,8 @@ from paddle_tpu.layers.io import (  # noqa: F401
     PyReader,
 )
 from paddle_tpu.layers.loss import *  # noqa: F401,F403
+from paddle_tpu.layers import detection  # noqa: F401
+from paddle_tpu.layers.detection import *  # noqa: F401,F403
 from paddle_tpu.layers.metric_op import accuracy, auc  # noqa: F401
 from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
 from paddle_tpu.layers.learning_rate_scheduler import (  # noqa: F401

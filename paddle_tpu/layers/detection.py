@@ -1,0 +1,316 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py).
+
+Static-shape conventions of the op layer apply: NMS and matching return
+fixed-capacity tensors with -1 padding; RoI ops take an explicit
+per-roi batch index instead of LoD (see ops/detection_ops.py).
+"""
+
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu import unique_name
+
+__all__ = [
+    "prior_box",
+    "density_prior_box",
+    "anchor_generator",
+    "box_coder",
+    "iou_similarity",
+    "box_clip",
+    "polygon_box_transform",
+    "bipartite_match",
+    "target_assign",
+    "multiclass_nms",
+    "roi_align",
+    "roi_pool",
+    "detection_output",
+    "ssd_loss",
+]
+
+
+def _out(helper, dtype="float32"):
+    return helper.create_variable_for_type_inference(dtype=dtype)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """(reference: layers/detection.py:1108)"""
+    helper = LayerHelper("prior_box", name=name)
+    boxes, var = _out(helper), _out(helper)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+            "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
+        })
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """(reference: layers/detection.py:1228)"""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes, var = _out(helper), _out(helper)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={
+            "densities": list(densities or []),
+            "fixed_sizes": list(fixed_sizes or []),
+            "fixed_ratios": list(fixed_ratios or [1.0]),
+            "variances": list(variance),
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+            "flatten_to_2d": flatten_to_2d,
+        })
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """(reference: layers/detection.py:1600)"""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors, var = _out(helper), _out(helper)
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={
+            "anchor_sizes": list(anchor_sizes or [64.0, 128.0, 256.0]),
+            "aspect_ratios": list(aspect_ratios or [0.5, 1.0, 2.0]),
+            "variances": list(variance),
+            "stride": list(stride or [16.0, 16.0]),
+            "offset": offset,
+        })
+    return anchors, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    """(reference: layers/detection.py:345)"""
+    helper = LayerHelper("box_coder", name=name)
+    out = _out(helper)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="box_coder", inputs=inputs, outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized})
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """(reference: layers/detection.py:317)"""
+    helper = LayerHelper("iou_similarity", name=name)
+    out = _out(helper)
+    helper.append_op(type="iou_similarity",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    """(reference: layers/detection.py:2059)"""
+    helper = LayerHelper("box_clip", name=name)
+    out = _out(helper)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    """(reference: layers/detection.py:482)"""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = _out(helper)
+    helper.append_op(type="polygon_box_transform",
+                     inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """(reference: layers/detection.py:702)"""
+    helper = LayerHelper("bipartite_match", name=name)
+    match_idx = _out(helper, "int32")
+    match_dist = _out(helper)
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [match_idx],
+                 "ColToRowMatchDist": [match_dist]},
+        attrs={"match_type": match_type or "bipartite",
+               "dist_threshold": dist_threshold or 0.5})
+    return match_idx, match_dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """(reference: layers/detection.py:788)"""
+    helper = LayerHelper("target_assign", name=name)
+    out = _out(helper, input.dtype)
+    out_weight = _out(helper)
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value})
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """(reference: layers/detection.py:2107). Static-shape output:
+    [B, keep_top_k, 6] rows (label, score, x1, y1, x2, y2) padded with
+    label -1, plus a [B] kept-count tensor."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = _out(helper)
+    count = _out(helper, "int32")
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "NmsRoisNum": [count]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "normalized": normalized,
+            "nms_eta": nms_eta,
+            "background_label": background_label,
+        })
+    return out, count
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_batch_idx=None,
+              name=None):
+    """(reference: layers/roi_align; rois_batch_idx replaces the LoD)"""
+    helper = LayerHelper("roi_align", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_idx is not None:
+        inputs["RoisBatchIdx"] = [rois_batch_idx]
+    helper.append_op(
+        type="roi_align", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale,
+               "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch_idx=None, name=None):
+    """(reference: layers/roi_pool)"""
+    helper = LayerHelper("roi_pool", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_idx is not None:
+        inputs["RoisBatchIdx"] = [rois_batch_idx]
+    helper.append_op(
+        type="roi_pool", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """Decode + NMS (reference: layers/detection.py:204 — box_coder
+    decode_center_size followed by multiclass_nms)."""
+    from paddle_tpu.layers import nn as nn_layers
+
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = nn_layers.transpose(scores, perm=[0, 2, 1])  # [B, C, M]
+    out, count = multiclass_nms(
+        decoded, scores_t, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, nms_eta=nms_eta,
+        background_label=background_label, name=name)
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mismatch_value=0, normalize=True, sample_size=None):
+    """SSD multibox loss (reference: layers/detection.py:874): match
+    priors to ground truths (bipartite + per-prediction), smooth-L1 on
+    matched locations, softmax CE with matched/background label targets.
+    Hard negative mining is replaced by full negative weighting
+    (TPU-friendly static shapes); sample_size/neg_pos_ratio are accepted
+    for API parity. Single-image form: location [M, 4], confidence
+    [M, C], gt_box [N_gt, 4], gt_label [N_gt, 1], prior_box [M, 4]."""
+    from paddle_tpu.layers import loss as loss_layers
+    from paddle_tpu.layers import nn as nn_layers
+
+    iou = iou_similarity(gt_box, prior_box)            # [N_gt, M]
+    match_idx, _ = bipartite_match(iou, match_type,
+                                   overlap_threshold)  # [1, M]
+    match_idx.stop_gradient = True
+    # per-prior location target: enc[match[m], m] (zeros unmatched)
+    enc = box_coder(prior_box, prior_box_var, gt_box)  # [N_gt, M, 4]
+    loc_target, loc_w = _gather_encoded(enc, match_idx)   # [M, 4], [M, 1]
+    loc_target.stop_gradient = True
+    # conf target: gt label where matched, background elsewhere
+    conf_target, _ = target_assign(
+        gt_label, match_idx, mismatch_value=background_label)  # [1, M, 1]
+    conf_target = nn_layers.reshape(conf_target, shape=[-1, 1])
+    conf_target.stop_gradient = True
+
+    loc_loss = nn_layers.reduce_sum(
+        nn_layers.elementwise_mul(
+            loss_layers.smooth_l1(location, loc_target), loc_w))
+    conf_loss = nn_layers.reduce_sum(
+        loss_layers.softmax_with_cross_entropy(
+            logits=confidence, label=conf_target))
+    total = nn_layers.elementwise_add(
+        nn_layers.scale(loc_loss, scale=loc_loss_weight),
+        nn_layers.scale(conf_loss, scale=conf_loss_weight))
+    if normalize:
+        denom = nn_layers.scale(nn_layers.reduce_sum(loc_w), scale=1.0,
+                                bias=1e-6)
+        total = nn_layers.elementwise_div(total, denom)
+    return total
+
+
+def _gather_encoded(enc, match_idx):
+    """enc [N_gt, M, 4] -> per-prior target [M, 4] + matched weight
+    [M, 1] via the match index (the gather the reference fuses into its
+    ssd_loss Python composition)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("gather_encoded")
+    out = helper.create_variable_for_type_inference(dtype=enc.dtype)
+    wt = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="gather_encoded",
+        inputs={"Encoded": [enc], "MatchIndices": [match_idx]},
+        outputs={"Out": [out], "OutWeight": [wt]})
+    return out, wt

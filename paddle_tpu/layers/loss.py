@@ -13,6 +13,8 @@ __all__ = [
     "smooth_l1",
     "kldiv_loss",
     "hinge_loss",
+    "warpctc",
+    "edit_distance",
 ]
 
 
@@ -134,3 +136,40 @@ def hinge_loss(input, label, name=None):
         outputs={"Loss": [out]},
     )
     return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """CTC loss (reference: layers/nn.py warpctc → warpctc_op.cc).
+    ``input``: [B, T, C] unnormalized logits (batch-major padded form of
+    the reference's LoD logits); returns [B, 1] per-sequence loss."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op(
+        type="warpctc", inputs=inputs, outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """Levenshtein distance (reference: layers/nn.py edit_distance).
+    Returns (distance [B, 1], sequence_num [1])."""
+    helper = LayerHelper("edit_distance", name=name)
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    seq_num = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        inputs["HypsLength"] = [input_length]
+    if label_length is not None:
+        inputs["RefsLength"] = [label_length]
+    helper.append_op(
+        type="edit_distance", inputs=inputs,
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized})
+    return out, seq_num

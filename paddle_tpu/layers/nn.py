@@ -733,7 +733,6 @@ def topk(input, k, name=None):
         outputs={"Out": [values], "Indices": [indices]},
         attrs={"k": k},
     )
-    values.stop_gradient = True
     indices.stop_gradient = True
     return values, indices
 

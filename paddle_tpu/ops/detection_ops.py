@@ -1,0 +1,515 @@
+"""Detection ops (reference: paddle/fluid/operators/detection/ — 11.7k
+LoC of CPU/CUDA kernels). TPU-native: every op is a static-shape jnp
+computation; ragged "kept detections" outputs use fixed-capacity tensors
+with -1 labels as padding (the reference's own no-detection marker), and
+greedy procedures (NMS, bipartite match) are bounded ``fori_loop``s.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_no_grad_op, register_op
+from paddle_tpu.ops.common import single
+
+
+# -- priors / anchors -------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - e) < 1e-6 for e in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+@register_no_grad_op("prior_box")
+def prior_box(ctx, ins, attrs):
+    """SSD prior boxes (reference: detection/prior_box_op.h:78-166 —
+    identical box ordering incl. min_max_aspect_ratios_order)."""
+    feat = single(ins, "Input")   # [N, C, H, W]
+    image = single(ins, "Image")  # [N, C, IH, IW]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = _expand_aspect_ratios(attrs.get("aspect_ratios", [1.0]),
+                                attrs.get("flip", False))
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = attrs.get("clip", False)
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+    mm_order = attrs.get("min_max_aspect_ratios_order", False)
+
+    # (box_w/2, box_h/2) per prior, reference ordering
+    half = []
+    for s, m in enumerate(min_sizes):
+        if mm_order:
+            half.append((m / 2.0, m / 2.0))
+            if max_sizes:
+                sq = math.sqrt(m * max_sizes[s]) / 2.0
+                half.append((sq, sq))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                half.append((m * math.sqrt(ar) / 2.0,
+                             m / math.sqrt(ar) / 2.0))
+        else:
+            for ar in ars:
+                half.append((m * math.sqrt(ar) / 2.0,
+                             m / math.sqrt(ar) / 2.0))
+            if max_sizes:
+                sq = math.sqrt(m * max_sizes[s]) / 2.0
+                half.append((sq, sq))
+    half = jnp.asarray(half, jnp.float32)               # [P, 2] (w/2, h/2)
+    num_priors = half.shape[0]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h  # [H]
+    cx = jnp.broadcast_to(cx[None, :, None], (h, w, num_priors))
+    cy = jnp.broadcast_to(cy[:, None, None], (h, w, num_priors))
+    bw = jnp.broadcast_to(half[None, None, :, 0], (h, w, num_priors))
+    bh = jnp.broadcast_to(half[None, None, :, 1], (h, w, num_priors))
+    boxes = jnp.stack([
+        (cx - bw) / img_w, (cy - bh) / img_h,
+        (cx + bw) / img_w, (cy + bh) / img_h,
+    ], axis=-1)                                          # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_no_grad_op("density_prior_box")
+def density_prior_box(ctx, ins, attrs):
+    """Densified priors (reference: detection/density_prior_box_op.h):
+    each fixed_size is sampled on a densityxdensity sub-grid."""
+    feat = single(ins, "Input")
+    image = single(ins, "Image")
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [])]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = attrs.get("clip", False)
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+
+    # per-prior (shift_x, shift_y, w/2, h/2) relative to the cell center
+    rel = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = size / density
+        for ar in fixed_ratios:
+            bw = size * math.sqrt(ar) / 2.0
+            bh = size / math.sqrt(ar) / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    sx = -size / 2.0 + shift / 2.0 + dj * shift
+                    sy = -size / 2.0 + shift / 2.0 + di * shift
+                    rel.append((sx, sy, bw, bh))
+    rel = jnp.asarray(rel, jnp.float32)                  # [P, 4]
+    num_priors = rel.shape[0]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cx = cx[None, :, None] + rel[None, None, :, 0]
+    cy = cy[:, None, None] + rel[None, None, :, 1]
+    cx = jnp.broadcast_to(cx, (h, w, num_priors))
+    cy = jnp.broadcast_to(cy, (h, w, num_priors))
+    bw = jnp.broadcast_to(rel[None, None, :, 2], (h, w, num_priors))
+    bh = jnp.broadcast_to(rel[None, None, :, 3], (h, w, num_priors))
+    boxes = jnp.stack([
+        (cx - bw) / img_w, (cy - bh) / img_h,
+        (cx + bw) / img_w, (cy + bh) / img_h,
+    ], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_no_grad_op("anchor_generator")
+def anchor_generator(ctx, ins, attrs):
+    """RPN anchors (reference: detection/anchor_generator_op.h): sizes x
+    ratios at image-scale stride, NOT normalized."""
+    feat = single(ins, "Input")
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64., 128., 256.])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [0.5, 1.0, 2.0])]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+
+    half = []
+    for r in ratios:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / r
+            base_w = round(math.sqrt(area_ratios))
+            base_h = round(base_w * r)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            half.append((scale_w * base_w / 2.0, scale_h * base_h / 2.0))
+    half = jnp.asarray(half, jnp.float32)
+    num_anchors = half.shape[0]
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * stride[1]
+    cx = jnp.broadcast_to(cx[None, :, None], (h, w, num_anchors))
+    cy = jnp.broadcast_to(cy[:, None, None], (h, w, num_anchors))
+    bw = jnp.broadcast_to(half[None, None, :, 0], (h, w, num_anchors))
+    bh = jnp.broadcast_to(half[None, None, :, 1], (h, w, num_anchors))
+    anchors = jnp.stack([cx - bw, cy - bh, cx + bw, cy + bh], axis=-1)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+# -- box arithmetic ---------------------------------------------------------
+
+@register_op("box_coder", no_grad_inputs=("PriorBox", "PriorBoxVar"))
+def box_coder(ctx, ins, attrs):
+    """Encode targets against priors / decode predictions (reference:
+    detection/box_coder_op.h encode_center_size & decode_center_size)."""
+    prior = single(ins, "PriorBox").reshape(-1, 4)        # [M, 4]
+    pvar = ins.get("PriorBoxVar", [None])
+    pvar = pvar[0] if pvar else None
+    tb = single(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = attrs.get("box_normalized", True)
+    one = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw / 2.0
+    pcy = prior[:, 1] + ph / 2.0
+    if pvar is not None:
+        pvar = pvar.reshape(-1, 4)
+
+    if code_type.lower().startswith("encode"):
+        # tb: [N, 4] ground truths -> out [N, M, 4]
+        tw = (tb[:, 2] - tb[:, 0] + one)[:, None]
+        th = (tb[:, 3] - tb[:, 1] + one)[:, None]
+        tcx = (tb[:, 0] + (tb[:, 2] - tb[:, 0] + one) / 2.0)[:, None]
+        tcy = (tb[:, 1] + (tb[:, 3] - tb[:, 1] + one) / 2.0)[:, None]
+        ox = (tcx - pcx[None, :]) / pw[None, :]
+        oy = (tcy - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw / pw[None, :]))
+        oh = jnp.log(jnp.abs(th / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        return {"OutputBox": [out]}
+
+    # decode: tb [N, M, 4] offsets -> boxes [N, M, 4]
+    if pvar is not None:
+        tb = tb * pvar[None, :, :]
+    dcx = tb[..., 0] * pw[None, :] + pcx[None, :]
+    dcy = tb[..., 1] * ph[None, :] + pcy[None, :]
+    dw = jnp.exp(tb[..., 2]) * pw[None, :]
+    dh = jnp.exp(tb[..., 3]) * ph[None, :]
+    out = jnp.stack([
+        dcx - dw / 2.0, dcy - dh / 2.0,
+        dcx + dw / 2.0 - one, dcy + dh / 2.0 - one,
+    ], axis=-1)
+    return {"OutputBox": [out]}
+
+
+def _pairwise_iou(x, y, normalized=True):
+    """x: [N, 4], y: [M, 4] -> [N, M] IoU (reference:
+    detection/iou_similarity_op.h IOUSimilarityFunctor)."""
+    one = 0.0 if normalized else 1.0
+    area_x = (x[:, 2] - x[:, 0] + one) * (x[:, 3] - x[:, 1] + one)
+    area_y = (y[:, 2] - y[:, 0] + one) * (y[:, 3] - y[:, 1] + one)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt + one, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity", grad=None)
+def iou_similarity(ctx, ins, attrs):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    return {"Out": [_pairwise_iou(x.reshape(-1, 4), y.reshape(-1, 4),
+                                  attrs.get("box_normalized", True))]}
+
+
+@register_no_grad_op("box_clip")
+def box_clip(ctx, ins, attrs):
+    """Clip boxes to image bounds (reference: detection/box_clip_op.h);
+    ImInfo rows are (height, width, scale)."""
+    boxes = single(ins, "Input")     # [B, M, 4] or [M, 4]
+    im_info = single(ins, "ImInfo")  # [B, 3]
+    squeeze = boxes.ndim == 2
+    if squeeze:
+        boxes = boxes[None]
+    h = (im_info[:, 0] / im_info[:, 2])[:, None] - 1.0
+    w = (im_info[:, 1] / im_info[:, 2])[:, None] - 1.0
+    out = jnp.stack([
+        jnp.clip(boxes[..., 0], 0.0, w),
+        jnp.clip(boxes[..., 1], 0.0, h),
+        jnp.clip(boxes[..., 2], 0.0, w),
+        jnp.clip(boxes[..., 3], 0.0, h),
+    ], axis=-1)
+    return {"Output": [out[0] if squeeze else out]}
+
+
+@register_no_grad_op("polygon_box_transform")
+def polygon_box_transform(ctx, ins, attrs):
+    """(reference: detection/polygon_box_transform_op.cc): for active
+    cells, offset predictions become absolute vertex coordinates."""
+    x = single(ins, "Input")  # [N, geo_channels, H, W]
+    n, c, h, w = x.shape
+    idx_w = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype)[None, :], (h, w))
+    idx_h = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    grid = jnp.stack([idx_w, idx_h] * (c // 2), axis=0) * 4.0
+    return {"Output": [grid[None] - x]}
+
+
+# -- matching / assignment --------------------------------------------------
+
+@register_no_grad_op("bipartite_match")
+def bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (reference:
+    detection/bipartite_match_op.cc BipartiteMatch): repeatedly take the
+    globally largest entry, exclude its row and column. With
+    match_type='per_prediction', unmatched columns above dist_threshold
+    also match their argmax row."""
+    dist = single(ins, "DistMat")
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    B, N, M = dist.shape
+    match_type = attrs.get("match_type", "bipartite")
+    thr = attrs.get("dist_threshold", 0.5)
+
+    def one(d):
+        def body(_, carry):
+            row_free, col_idx, col_dist = carry
+            masked = jnp.where(
+                row_free[:, None] & (col_idx[None, :] < 0), d, -1.0)
+            flat = jnp.argmax(masked)
+            r, c = flat // M, flat % M
+            ok = masked[r, c] > 0
+            row_free = row_free.at[r].set(
+                jnp.where(ok, False, row_free[r]))
+            col_idx = col_idx.at[c].set(
+                jnp.where(ok, r.astype(jnp.int32), col_idx[c]))
+            col_dist = col_dist.at[c].set(
+                jnp.where(ok, masked[r, c], col_dist[c]))
+            return row_free, col_idx, col_dist
+
+        init = (jnp.ones((N,), bool), jnp.full((M,), -1, jnp.int32),
+                jnp.zeros((M,), d.dtype))
+        _, col_idx, col_dist = lax.fori_loop(0, min(N, M), body, init)
+        if match_type == "per_prediction":
+            best_r = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_d = jnp.max(d, axis=0)
+            extra = (col_idx < 0) & (best_d >= thr)
+            col_idx = jnp.where(extra, best_r, col_idx)
+            col_dist = jnp.where(extra, best_d, col_dist)
+        return col_idx, col_dist
+
+    col_idx, col_dist = jax.vmap(one)(dist)
+    if squeeze:
+        col_idx, col_dist = col_idx[0:1], col_dist[0:1]
+    return {"ColToRowMatchIndices": [col_idx],
+            "ColToRowMatchDist": [col_dist]}
+
+
+@register_no_grad_op("target_assign")
+def target_assign(ctx, ins, attrs):
+    """Gather rows by match index, mismatch_value where unmatched
+    (reference: detection/target_assign_op.h)."""
+    x = single(ins, "X")               # [N, D] per-gt rows (or [B, N, D])
+    match = single(ins, "MatchIndices")  # [B, M]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    if x.ndim == 2:
+        x = jnp.broadcast_to(x[None], (match.shape[0],) + x.shape)
+    idx = jnp.maximum(match, 0)
+    gathered = jnp.take_along_axis(
+        x, idx[..., None].astype(jnp.int32), axis=1)
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, gathered, mismatch_value)
+    wt = matched.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+# -- NMS --------------------------------------------------------------------
+
+@register_no_grad_op("multiclass_nms")
+def multiclass_nms(ctx, ins, attrs):
+    """Multi-class NMS (reference: detection/multiclass_nms_op.cc). The
+    reference emits a ragged LoD tensor of kept detections; the
+    static-shape form is [B, keep_top_k, 6] rows (label, score, x1, y1,
+    x2, y2) padded with label -1 — the reference's own no-detection
+    marker — plus a [B] count output."""
+    boxes = single(ins, "BBoxes")    # [B, M, 4]
+    scores = single(ins, "Scores")   # [B, C, M]
+    bg = attrs.get("background_label", 0)
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    eta = attrs.get("nms_eta", 1.0)
+    B, C, M = scores.shape
+    nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
+    keep_top_k = keep_top_k if keep_top_k > 0 else C * nms_top_k
+
+    def nms_one_class(b_boxes, c_scores):
+        # top candidates by score
+        s, order = lax.top_k(c_scores, nms_top_k)          # [K]
+        cand = b_boxes[order]                               # [K, 4]
+        iou = _pairwise_iou(cand, cand)
+        valid = s > score_thr
+
+        def body(i, keep):
+            # suppressed if overlapping an earlier KEPT candidate (keep
+            # bits at indices >= i are still False, so no masking needed)
+            sup = jnp.any((iou[i] > nms_thr) & keep)
+            return keep.at[i].set(valid[i] & ~sup)
+
+        keep = lax.fori_loop(0, nms_top_k, body,
+                             jnp.zeros((nms_top_k,), bool))
+        return s, order, keep
+
+    if all(c == bg for c in range(C)):
+        raise ValueError(
+            "multiclass_nms: every class is the background label (%d of "
+            "%d); no detections are possible" % (bg, C))
+
+    def one_image(b_boxes, b_scores):
+        rows = []
+        for c in range(C):
+            if c == bg:
+                continue
+            s, order, keep = nms_one_class(b_boxes, b_scores[c])
+            sc = jnp.where(keep, s, -1.0)
+            rows.append((jnp.full((nms_top_k,), c, jnp.float32), sc,
+                         b_boxes[order]))
+        labels = jnp.concatenate([r[0] for r in rows])
+        scs = jnp.concatenate([r[1] for r in rows])
+        bxs = jnp.concatenate([r[2] for r in rows])
+        k = min(keep_top_k, scs.shape[0])
+        top_s, top_i = lax.top_k(scs, k)
+        out = jnp.concatenate([
+            jnp.where(top_s > score_thr, labels[top_i], -1.0)[:, None],
+            top_s[:, None], bxs[top_i]], axis=-1)
+        count = jnp.sum(top_s > score_thr).astype(jnp.int32)
+        if k < keep_top_k:
+            pad = jnp.full((keep_top_k - k, 6), -1.0, out.dtype)
+            out = jnp.concatenate([out, pad])
+        return out, count
+
+    del eta  # adaptive eta unsupported (static shapes); standard NMS
+    outs, counts = jax.vmap(one_image)(boxes, scores)
+    return {"Out": [outs], "NmsRoisNum": [counts]}
+
+
+# -- RoI ops ----------------------------------------------------------------
+
+@register_op("roi_align", no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def roi_align(ctx, ins, attrs):
+    """RoI Align with bilinear sampling (reference:
+    detection... operators/roi_align_op.h). ROIs [R, 4] at image scale;
+    RoisBatchIdx [R] maps each roi to its batch image (the LoD in the
+    reference)."""
+    x = single(ins, "X")             # [N, C, H, W]
+    rois = single(ins, "ROIs")       # [R, 4]
+    bidx = ins.get("RoisBatchIdx", [None])
+    bidx = bidx[0] if bidx and bidx[0] is not None else jnp.zeros(
+        (rois.shape[0],), jnp.int32)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = int(attrs.get("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    N, C, H, W = x.shape
+
+    def one_roi(roi, bi):
+        img = x[bi]                  # [C, H, W]
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        # sample grid: (ph*ratio, pw*ratio) bilinear points
+        gy = y1 + (jnp.arange(ph * ratio) + 0.5) * rh / (ph * ratio)
+        gx = x1 + (jnp.arange(pw * ratio) + 0.5) * rw / (pw * ratio)
+        gy = jnp.clip(gy, 0.0, H - 1.0)
+        gx = jnp.clip(gx, 0.0, W - 1.0)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        wy = (gy - y0)[None, :, None]
+        wx = (gx - x0)[None, None, :]
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0]
+        v11 = img[:, y1i][:, :, x1i]
+        samp = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+        # average samples within each bin
+        samp = samp.reshape(C, ph, ratio, pw, ratio)
+        del bin_w, bin_h
+        return samp.mean(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(rois, bidx.astype(jnp.int32))
+    return {"Out": [out]}
+
+
+@register_op("roi_pool", no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def roi_pool(ctx, ins, attrs):
+    """RoI max pooling (reference: operators/roi_pool_op.h) — implemented
+    as dense-sampled max over each bin (static-shape equivalent)."""
+    x = single(ins, "X")
+    rois = single(ins, "ROIs")
+    bidx = ins.get("RoisBatchIdx", [None])
+    bidx = bidx[0] if bidx and bidx[0] is not None else jnp.zeros(
+        (rois.shape[0],), jnp.int32)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+    ratio = 4  # samples per bin edge
+
+    def one_roi(roi, bi):
+        img = x[bi]
+        x1, y1, x2, y2 = jnp.round(roi * scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        gy = jnp.clip(y1 + (jnp.arange(ph * ratio) + 0.5) * rh
+                      / (ph * ratio), 0, H - 1).astype(jnp.int32)
+        gx = jnp.clip(x1 + (jnp.arange(pw * ratio) + 0.5) * rw
+                      / (pw * ratio), 0, W - 1).astype(jnp.int32)
+        samp = img[:, gy][:, :, gx].reshape(C, ph, ratio, pw, ratio)
+        return samp.max(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(rois, bidx.astype(jnp.int32))
+    return {"Out": [out]}
+
+
+@register_op("gather_encoded", no_grad_inputs=("MatchIndices",))
+def gather_encoded(ctx, ins, attrs):
+    """enc [N_gt, M, 4] + match [1, M] -> per-prior target [M, 4] and
+    matched weight [M, 1] (the ssd_loss gather, see layers/detection.py)."""
+    enc = single(ins, "Encoded")
+    match = single(ins, "MatchIndices").reshape(-1)      # [M]
+    idx = jnp.maximum(match, 0).astype(jnp.int32)
+    m = jnp.arange(enc.shape[1])
+    gathered = enc[idx, m]                               # [M, 4]
+    w = (match >= 0).astype(jnp.float32)[:, None]
+    return {"Out": [jnp.where(w > 0, gathered, 0.0)], "OutWeight": [w]}

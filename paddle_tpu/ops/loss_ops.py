@@ -3,8 +3,9 @@ softmax_with_cross_entropy_op.cc, mean_op.cc, squared_l2 ops...)."""
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.registry import register_no_grad_op, register_op
 from paddle_tpu.ops.common import fp32_accum, single
 
 
@@ -153,3 +154,142 @@ def hinge_loss(ctx, ins, attrs):
     logits = single(ins, "Logits")
     labels = single(ins, "Labels")
     return {"Loss": [jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)]}
+
+
+@register_op("warpctc", no_grad_inputs=("Label", "LogitsLength",
+                                        "LabelLength"))
+def warpctc(ctx, ins, attrs):
+    """CTC loss via the log-domain alpha recursion (reference:
+    operators/warpctc_op.cc wrapping the warp-ctc library; here the
+    forward-backward is a differentiable ``lax.scan``, so the gradient
+    falls out of autodiff instead of warp-ctc's hand-written backward).
+
+    Logits: [B, T, C] UNNORMALIZED (softmax applied internally, like
+    warp-ctc); Label: [B, L] int ids; LogitsLength/LabelLength: [B]."""
+    logits = single(ins, "Logits")
+    labels = single(ins, "Label")
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = attrs.get("norm_by_times", False)
+    B, T, C = logits.shape
+    if labels.ndim == 3 and labels.shape[-1] == 1:
+        labels = labels[..., 0]
+    L = labels.shape[1]
+    in_len = ins.get("LogitsLength", [None])
+    in_len = (in_len[0].reshape(-1).astype(jnp.int32)
+              if in_len and in_len[0] is not None
+              else jnp.full((B,), T, jnp.int32))
+    lab_len = ins.get("LabelLength", [None])
+    lab_len = (lab_len[0].reshape(-1).astype(jnp.int32)
+               if lab_len and lab_len[0] is not None
+               else jnp.full((B,), L, jnp.int32))
+
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    # skip transition s-2 -> s allowed when ext[s] is a non-blank
+    # different from ext[s-2]
+    can_skip = jnp.concatenate([
+        jnp.zeros((B, 2), bool),
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]),
+    ], axis=1)                                          # [B, S]
+    NEG = -1e30
+
+    lp0 = log_probs[:, 0]
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(lp0[:, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(lp0, ext[:, 1:2], axis=1)[:, 0])
+
+    def lse(*xs):
+        stacked = jnp.stack(xs)
+        m = jnp.max(stacked, axis=0)
+        m_safe = jnp.maximum(m, NEG)
+        return m_safe + jnp.log(
+            jnp.sum(jnp.exp(stacked - m_safe), axis=0))
+
+    def step(alpha, inp):
+        lp_t, t = inp                                   # [B, C]
+        s1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        s2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        s2 = jnp.where(can_skip, s2, NEG)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)   # [B, S]
+        new = lse(alpha, s1, s2) + emit
+        # rows whose sequence already ended keep their alpha frozen
+        new = jnp.where((t < in_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(log_probs[:, 1:], 1, 0), jnp.arange(1, T)))
+
+    # P(label) = alpha[S_eff-1] + alpha[S_eff-2], S_eff = 2*lab_len+1
+    last = 2 * lab_len                                  # index of last blank
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(lab_len > 0, a_prev, NEG)
+    loss = -lse(a_last, a_prev)
+    if norm_by_times:
+        loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+    return {"Loss": [loss.reshape(B, 1).astype(logits.dtype)]}
+
+
+@register_no_grad_op("edit_distance")
+def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance between hypothesis and reference id rows
+    (reference: operators/edit_distance_op.cc), DP row-scanned over the
+    hypothesis dimension."""
+    hyp = single(ins, "Hyps")
+    ref = single(ins, "Refs")
+    if hyp.ndim == 3 and hyp.shape[-1] == 1:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3 and ref.shape[-1] == 1:
+        ref = ref[..., 0]
+    B, L1 = hyp.shape
+    L2 = ref.shape[1]
+    h_len = ins.get("HypsLength", [None])
+    h_len = (h_len[0].reshape(-1).astype(jnp.int32)
+             if h_len and h_len[0] is not None
+             else jnp.full((B,), L1, jnp.int32))
+    r_len = ins.get("RefsLength", [None])
+    r_len = (r_len[0].reshape(-1).astype(jnp.int32)
+             if r_len and r_len[0] is not None
+             else jnp.full((B,), L2, jnp.int32))
+    normalized = attrs.get("normalized", True)
+
+    cols = jnp.arange(L2 + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(cols, (B, L2 + 1))          # D[0, j] = j
+
+    def step(carry, inp):
+        row, = carry
+        h_tok, i = inp                                   # [B], scalar
+        match = (ref == h_tok[:, None])                  # [B, L2]
+        # new[0] = i+1; new[j] = min(row[j]+1, new[j-1]+1,
+        #                            row[j-1]+ (0 if match else 1))
+        diag = row[:, :-1] + jnp.where(match, 0.0, 1.0)
+        up = row[:, 1:] + 1.0
+
+        def inner(j, new):
+            cand = jnp.minimum(jnp.minimum(up[:, j], diag[:, j]),
+                               new[:, j] + 1.0)
+            return new.at[:, j + 1].set(cand)
+
+        new = jnp.full((B, L2 + 1), 0.0).at[:, 0].set(
+            (i + 1).astype(jnp.float32))
+        new = lax.fori_loop(0, L2, inner, new)
+        # rows past the hypothesis length keep the old DP row
+        new = jnp.where((i < h_len)[:, None], new, row)
+        return (new,), None
+
+    (row,), _ = lax.scan(
+        step, (row0,), (jnp.moveaxis(hyp, 1, 0), jnp.arange(L1)))
+    dist = jnp.take_along_axis(row, r_len[:, None], axis=1)[:, 0]
+    # rows where the reference is empty: distance = hyp length
+    dist = jnp.where(r_len == 0, h_len.astype(dist.dtype), dist)
+    seq_num = jnp.asarray([B], jnp.int64)
+    if normalized:
+        dist = dist / jnp.maximum(r_len.astype(dist.dtype), 1.0)
+    return {"Out": [dist.reshape(B, 1)], "SequenceNum": [seq_num]}

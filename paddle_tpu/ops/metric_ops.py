@@ -64,3 +64,58 @@ def auc(ctx, ins, attrs):
         "StatPosOut": [new_pos],
         "StatNegOut": [new_neg],
     }
+
+
+@register_no_grad_op("precision_recall")
+def precision_recall(ctx, ins, attrs):
+    """Per-class precision/recall/F1 with state accumulation (reference:
+    operators/metrics/precision_recall_op.cc). Outputs BatchMetrics and
+    AccumMetrics as [macro-P, macro-R, macro-F1, micro-P, micro-R,
+    micro-F1] and AccumStatesInfo [C, 4] = (TP, FP, TN, FN) per class."""
+    idx = single(ins, "Indices")        # [N, 1] predicted class
+    labels = single(ins, "Labels")      # [N, 1]
+    weights = ins.get("Weights", [None])
+    weights = weights[0] if weights and weights[0] is not None else None
+    states = ins.get("StatesInfo", [None])
+    states = states[0] if states and states[0] is not None else None
+    C = int(attrs["class_number"])
+    pred = idx.reshape(-1).astype(jnp.int32)
+    lab = labels.reshape(-1).astype(jnp.int32)
+    w = (weights.reshape(-1).astype(jnp.float32)
+         if weights is not None else jnp.ones_like(pred, jnp.float32))
+
+    cls = jnp.arange(C)[:, None]                         # [C, 1]
+    is_pred = (pred[None, :] == cls)
+    is_lab = (lab[None, :] == cls)
+    tp = jnp.sum(jnp.where(is_pred & is_lab, w, 0.0), axis=1)
+    fp = jnp.sum(jnp.where(is_pred & ~is_lab, w, 0.0), axis=1)
+    fn = jnp.sum(jnp.where(~is_pred & is_lab, w, 0.0), axis=1)
+    tn = jnp.sum(w) - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)   # [C, 4]
+
+    def metrics(st):
+        tp_, fp_, _, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        p = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12),
+                      1.0)
+        r = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
+                      1.0)
+        f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12),
+                       0.0)
+        micro_p = jnp.where(
+            jnp.sum(tp_ + fp_) > 0,
+            jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fp_), 1e-12), 1.0)
+        micro_r = jnp.where(
+            jnp.sum(tp_ + fn_) > 0,
+            jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fn_), 1e-12), 1.0)
+        micro_f1 = jnp.where(
+            micro_p + micro_r > 0,
+            2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-12),
+            0.0)
+        return jnp.stack([jnp.mean(p), jnp.mean(r), jnp.mean(f1),
+                          micro_p, micro_r, micro_f1])
+
+    accum_states = (batch_states + states.astype(jnp.float32)
+                    if states is not None else batch_states)
+    return {"BatchMetrics": [metrics(batch_states)],
+            "AccumMetrics": [metrics(accum_states)],
+            "AccumStatesInfo": [accum_states]}

@@ -222,12 +222,29 @@ def shape_op(ctx, ins, attrs):
     return {"Out": [jnp.asarray(x.shape, dtype=jnp.int32)]}
 
 
-@register_no_grad_op("top_k")
+@register_op("top_k", infer_shape=None)
 def top_k(ctx, ins, attrs):
     x = single(ins, "X")
     k = attrs.get("k", 1)
     vals, idx = jax.lax.top_k(x, k)
     return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_no_grad_op("top_k_grad")
+def top_k_grad(ctx, ins, attrs):
+    """Value gradient scatters back to the selected positions (reference:
+    the top_k grad kernel added alongside operators/top_k_op.cc)."""
+    x = single(ins, "X")
+    og = single(ins, "Out@GRAD")
+    k = attrs.get("k", 1)
+    _, idx = jax.lax.top_k(x, k)
+    n = x.shape[-1]
+    x2 = x.reshape(-1, n)
+    idx2 = idx.reshape(-1, k)
+    og2 = og.reshape(-1, k).astype(x.dtype)
+    rows = jnp.arange(x2.shape[0])[:, None]
+    gx = jnp.zeros_like(x2).at[rows, idx2].add(og2)
+    return {"X@GRAD": [gx.reshape(x.shape)]}
 
 
 @register_no_grad_op("arg_max")

@@ -1,0 +1,100 @@
+"""AsyncExecutor + MultiSlotDataFeed: the file-fed multi-threaded CTR
+path (reference: tests/unittests/test_async_executor.py — same textproto
+feed description and bow_net shape, on synthetic data instead of the
+downloaded imdb corpus)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+PROTO = (
+    'name: "MultiSlotDataFeed"\n'
+    "batch_size: 8\n"
+    "multi_slot_desc {\n"
+    "   slots {\n"
+    '       name: "words"\n'
+    '       type: "uint64"\n'
+    "       is_dense: false\n"
+    "       is_used: true\n"
+    "   }\n"
+    "   slots {\n"
+    '       name: "label"\n'
+    '       type: "uint64"\n'
+    "       is_dense: true\n"
+    "       is_used: true\n"
+    "   }\n"
+    "}")
+
+VOCAB = 200
+
+
+def _write_files(tmp_path, n_files=4, lines_per_file=64, seed=0):
+    """Synthetic separable data in the MultiSlot text format: label 1 iff
+    the sequence has more ids from the upper half of the vocab."""
+    rng = np.random.RandomState(seed)
+    files = []
+    for i in range(n_files):
+        path = str(tmp_path / ("part-%d" % i))
+        with open(path, "w") as f:
+            for _ in range(lines_per_file):
+                n = rng.randint(3, 12)
+                ids = rng.randint(0, VOCAB, n)
+                label = int((ids >= VOCAB // 2).sum() > n / 2)
+                f.write("%d %s 1 %d\n" % (n, " ".join(map(str, ids)),
+                                          label))
+        files.append(path)
+    return files
+
+
+def _build():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[-1], dtype="int64")
+        wlen = fluid.layers.data(name="words@LEN", shape=[1],
+                                 dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[VOCAB, 16],
+                                     is_sparse=True)
+        bow = fluid.layers.sequence_pool(emb, "sum", length=wlen)
+        h = fluid.layers.fc(input=fluid.layers.tanh(bow), size=32,
+                            act="tanh")
+        pred = fluid.layers.fc(input=h, size=2)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=label))
+        acc = fluid.layers.accuracy(input=pred, label=label)
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    return main, startup, loss, acc
+
+
+def test_data_feed_desc_roundtrip():
+    desc = fluid.DataFeedDesc(PROTO)
+    assert desc.batch_size == 8
+    assert [s.name for s in desc.slots] == ["words", "label"]
+    assert not desc.slots[0].is_dense and desc.slots[1].is_dense
+    desc2 = fluid.DataFeedDesc(desc.desc())
+    assert desc2.batch_size == 8
+    assert [s.name for s in desc2.slots] == ["words", "label"]
+    desc.set_batch_size(16)
+    desc.set_dense_slots(["words"])
+    assert desc.batch_size == 16 and desc.slots[0].is_dense
+
+
+def test_async_executor_trains_multithreaded(tmp_path):
+    files = _write_files(tmp_path)
+    main, startup, loss, acc = _build()
+    desc = fluid.DataFeedDesc(PROTO)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        async_exe = fluid.AsyncExecutor(fluid.CPUPlace())
+        async_exe.run_startup_program(startup)
+        first = async_exe.run(main, desc, files, thread_num=2,
+                              fetch=[loss, acc])
+        # several epochs of hogwild training
+        for _ in range(14):
+            last = async_exe.run(main, desc, files, thread_num=2,
+                                 fetch=[loss, acc])
+    assert np.isfinite(first).all() and np.isfinite(last).all()
+    assert last[0] < first[0] * 0.8, (first, last)
+    assert last[1] > max(first[1], 0.7), (first, last)

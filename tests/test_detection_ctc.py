@@ -1,0 +1,332 @@
+"""Detection op family + CTC/edit-distance/precision-recall tests.
+
+Oracles: hand-computed geometry for priors/IoU/coder, torch's CPU
+ctc_loss for warpctc (the same role torch plays in test_ops_nn.py), and
+numpy reference implementations elsewhere. Mirrors the reference's
+tests/unittests/test_prior_box_op.py, test_bipartite_match_op.py,
+test_multiclass_nms_op.py, test_warpctc_op.py, test_edit_distance_op.py,
+test_precision_recall_op.py.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _run(build, feed, n_fetch=None):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed, fetch_list=list(fetch))
+    return [np.asarray(o) for o in outs]
+
+
+def test_prior_box_geometry():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+
+    def build():
+        f = fluid.layers.data(name="f", shape=[8, 2, 2], dtype="float32")
+        im = fluid.layers.data(name="im", shape=[3, 32, 32],
+                               dtype="float32")
+        b, v = fluid.layers.prior_box(
+            f, im, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        return [b, v]
+
+    boxes, var = _run(build, {"f": feat, "im": img})
+    # priors per cell: ar {1, 2, 0.5} on min_size + sqrt(min*max) square
+    assert boxes.shape == (2, 2, 4, 4)
+    # cell (0,0): center (8, 8) with step 16, offset 0.5
+    cx, cy = 8.0, 8.0
+    # first prior: ar 1 -> 8x8 box
+    np.testing.assert_allclose(
+        boxes[0, 0, 0], [(cx - 4) / 32, (cy - 4) / 32,
+                         (cx + 4) / 32, (cy + 4) / 32], rtol=1e-5)
+    # ar 2: w = 8*sqrt(2)/2, h = 8/sqrt(2)/2
+    w2, h2 = 8 * np.sqrt(2) / 2, 8 / np.sqrt(2) / 2
+    np.testing.assert_allclose(
+        boxes[0, 0, 1], [(cx - w2) / 32, (cy - h2) / 32,
+                         (cx + w2) / 32, (cy + h2) / 32], rtol=1e-5)
+    # last prior: sqrt(8*16) square
+    sq = np.sqrt(8 * 16.0) / 2
+    np.testing.assert_allclose(
+        boxes[0, 0, 3], [(cx - sq) / 32, (cy - sq) / 32,
+                         (cx + sq) / 32, (cy + sq) / 32], rtol=1e-5)
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_iou_similarity_oracle():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        return [fluid.layers.iou_similarity(xv, yv)]
+
+    (iou,) = _run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 0.0, atol=1e-6)
+    # box [1,1,3,3] vs [2,2,4,4]: inter 1, union 7
+    np.testing.assert_allclose(iou[1, 1], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    priors = np.sort(rng.rand(6, 4).astype(np.float32), axis=1)
+    var = np.full((6, 4), 0.1, np.float32)
+    gt = np.sort(rng.rand(3, 4).astype(np.float32), axis=1)
+
+    def build():
+        p = fluid.layers.data(name="p", shape=[4], dtype="float32")
+        pv = fluid.layers.data(name="pv", shape=[4], dtype="float32")
+        g = fluid.layers.data(name="g", shape=[4], dtype="float32")
+        enc = fluid.layers.box_coder(p, pv, g,
+                                     code_type="encode_center_size")
+        dec = fluid.layers.box_coder(p, pv, enc,
+                                     code_type="decode_center_size")
+        return [enc, dec]
+
+    enc, dec = _run(build, {"p": priors, "pv": var, "g": gt})
+    assert enc.shape == (3, 6, 4)
+    for i in range(3):
+        for j in range(6):
+            np.testing.assert_allclose(dec[i, j], gt[i], rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    d = np.array([[0.9, 0.2, 0.1],
+                  [0.8, 0.7, 0.3]], np.float32)
+
+    def build():
+        dv = fluid.layers.data(name="d", shape=[3], dtype="float32")
+        idx, dist = fluid.layers.bipartite_match(dv)
+        return [idx, dist]
+
+    idx, dist = _run(build, {"d": d})
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; col 2 unmatched
+    np.testing.assert_array_equal(idx.reshape(-1), [0, 1, -1])
+    np.testing.assert_allclose(dist.reshape(-1), [0.9, 0.7, 0.0],
+                               rtol=1e-6)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # two heavily overlapping boxes + one distinct, single class
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.0, 0.0, 0.0],      # class 0 = background
+                        [0.9, 0.8, 0.7]]], np.float32)
+
+    def build():
+        b = fluid.layers.data(name="b", shape=[3, 4], dtype="float32")
+        s = fluid.layers.data(name="s", shape=[2, 3], dtype="float32")
+        out, cnt = fluid.layers.multiclass_nms(
+            b, s, score_threshold=0.1, nms_top_k=3, keep_top_k=3,
+            nms_threshold=0.5)
+        return [out, cnt]
+
+    out, cnt = _run(build, {"b": boxes, "s": scores})
+    assert int(cnt[0]) == 2
+    kept = out[0][out[0][:, 0] >= 0]
+    # the 0.8 box is suppressed by the 0.9 box (IoU ~0.68)
+    np.testing.assert_allclose(sorted(kept[:, 1].tolist()), [0.7, 0.9],
+                               rtol=1e-5)
+
+
+def test_roi_align_constant_and_ramp():
+    # constant feature -> pooled value equals the constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[2, 8, 8], dtype="float32")
+        r = fluid.layers.data(name="r", shape=[4], dtype="float32")
+        return [fluid.layers.roi_align(xv, r, pooled_height=2,
+                                       pooled_width=2)]
+
+    (out,) = _run(build, {"x": x, "r": rois})
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.5, rtol=1e-5)
+
+
+def test_warpctc_matches_torch():
+    B, T, C, L = 3, 8, 5, 3
+    rng = np.random.RandomState(0)
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, L)).astype(np.int64)
+    in_len = np.array([8, 6, 7], np.int64)
+    lab_len = np.array([3, 2, 3], np.int64)
+
+    def build():
+        lg = fluid.layers.data(name="lg", shape=[T, C], dtype="float32",
+                               stop_gradient=False)
+        lb = fluid.layers.data(name="lb", shape=[L], dtype="int64")
+        il = fluid.layers.data(name="il", shape=[1], dtype="int64")
+        ll = fluid.layers.data(name="ll", shape=[1], dtype="int64")
+        loss = fluid.layers.warpctc(lg, lb, blank=0, input_length=il,
+                                    label_length=ll)
+        total = fluid.layers.mean(loss)
+        fluid.append_backward(total)
+        return [loss, "lg@GRAD"]
+
+    loss, glg = _run(build, {"lg": logits, "lb": labels, "il": in_len,
+                             "ll": lab_len})
+
+    t_logits = torch.tensor(logits.transpose(1, 0, 2), requires_grad=True)
+    t_loss = F.ctc_loss(
+        t_logits.log_softmax(-1), torch.tensor(labels),
+        torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+        reduction="none", zero_infinity=False)
+    np.testing.assert_allclose(loss.reshape(-1),
+                               t_loss.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    (t_loss.mean()).backward()
+    np.testing.assert_allclose(
+        glg, t_logits.grad.numpy().transpose(1, 0, 2), rtol=1e-3,
+        atol=1e-5)
+
+
+def test_warpctc_training_decreases():
+    """A tiny CTC model fits one target sequence."""
+    B, T, C, L = 4, 12, 6, 4
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, T, 8).astype(np.float32)
+    labels = rng.randint(1, C, (B, L)).astype(np.int64)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[T, 8], dtype="float32")
+        lb = fluid.layers.data(name="lb", shape=[L], dtype="int64")
+        h = fluid.layers.fc(input=xv, size=C, num_flatten_dims=2)
+        loss = fluid.layers.mean(fluid.layers.warpctc(h, lb))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"x": x, "lb": labels}, fetch_list=[loss])[0]))
+            for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_edit_distance_oracle():
+    hyp = np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int64)
+    ref = np.array([[1, 3, 3], [5, 6, 7]], np.int64)
+    h_len = np.array([4, 3], np.int64)
+    r_len = np.array([3, 3], np.int64)
+
+    def build():
+        h = fluid.layers.data(name="h", shape=[4], dtype="int64")
+        r = fluid.layers.data(name="r", shape=[3], dtype="int64")
+        hl = fluid.layers.data(name="hl", shape=[1], dtype="int64")
+        rl = fluid.layers.data(name="rl", shape=[1], dtype="int64")
+        d, n = fluid.layers.edit_distance(h, r, normalized=False,
+                                          input_length=hl,
+                                          label_length=rl)
+        return [d, n]
+
+    d, n = _run(build, {"h": hyp, "r": ref, "hl": h_len, "rl": r_len})
+    # row 0: 1234 vs 133 -> sub(2->3)=... distance 2; row 1: identical
+    assert d.reshape(-1).tolist() == [2.0, 0.0]
+    assert int(n[0]) == 2
+
+
+def test_precision_recall_oracle():
+    pred = np.array([[0], [1], [1], [2], [2], [2]], np.int64)
+    label = np.array([[0], [1], [2], [2], [2], [0]], np.int64)
+    probs = np.ones((6, 1), np.float32)
+
+    def build():
+        i = fluid.layers.data(name="i", shape=[1], dtype="int64")
+        l = fluid.layers.data(name="l", shape=[1], dtype="int64")
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("pr")
+        batch = helper.create_variable_for_type_inference("float32")
+        accum = helper.create_variable_for_type_inference("float32")
+        states = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="precision_recall",
+            inputs={"Indices": [i], "Labels": [l]},
+            outputs={"BatchMetrics": [batch], "AccumMetrics": [accum],
+                     "AccumStatesInfo": [states]},
+            attrs={"class_number": 3})
+        return [batch, states]
+
+    batch, states = _run(build, {"i": pred, "l": label})
+    # class 0: TP1 FP0 FN1; class 1: TP1 FP1 FN0; class 2: TP2 FP1 FN1
+    np.testing.assert_allclose(states[:, 0], [1, 1, 2])  # TP
+    np.testing.assert_allclose(states[:, 1], [0, 1, 1])  # FP
+    np.testing.assert_allclose(states[:, 3], [1, 0, 1])  # FN
+    # micro: P = 4/6, R = 4/6
+    np.testing.assert_allclose(batch[3], 4 / 6, rtol=1e-5)
+    np.testing.assert_allclose(batch[4], 4 / 6, rtol=1e-5)
+
+
+def test_topk_gradient():
+    x = np.array([[1.0, 3.0, 2.0, 5.0],
+                  [4.0, 1.0, 9.0, 2.0]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                               stop_gradient=False)
+        vals, idx = fluid.layers.topk(xv, k=2)
+        loss = fluid.layers.mean(vals)
+        fluid.append_backward(loss)
+        return ["x@GRAD"]
+
+    (gx,) = _run(build, {"x": x})
+    expect = np.zeros_like(x)
+    expect[0, 3] = expect[0, 1] = 0.25
+    expect[1, 2] = expect[1, 0] = 0.25
+    np.testing.assert_allclose(gx, expect, rtol=1e-6)
+
+
+def test_ssd_loss_trains():
+    """detection pipeline smoke: priors + ssd_loss produce a finite,
+    decreasing loss on a toy matching problem."""
+    M, C, NG = 8, 4, 2
+    rng = np.random.RandomState(0)
+    priors = np.sort(rng.rand(M, 2), axis=1)
+    priors = np.concatenate([priors[:, :1], priors[:, :1],
+                             priors[:, 1:], priors[:, 1:]],
+                            axis=1).astype(np.float32)
+    pvar = np.full((M, 4), 0.1, np.float32)
+    gt = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]],
+                  np.float32)
+    gl = np.array([[1], [2]], np.int64)
+    feats = rng.randn(M, 16).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        f = fluid.layers.data(name="f", shape=[16], dtype="float32")
+        p = fluid.layers.data(name="p", shape=[4], dtype="float32")
+        pv = fluid.layers.data(name="pv", shape=[4], dtype="float32")
+        g = fluid.layers.data(name="g", shape=[4], dtype="float32")
+        glv = fluid.layers.data(name="gl", shape=[1], dtype="int64")
+        loc = fluid.layers.fc(input=f, size=4)
+        conf = fluid.layers.fc(input=f, size=C)
+        loss = fluid.layers.ssd_loss(loc, conf, g, glv, p,
+                                     prior_box_var=pv)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"f": feats, "p": priors, "pv": pvar, "g": gt, "gl": gl}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(25)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
