@@ -125,6 +125,51 @@ def bench_bert_base(batch=None, steps=10, warmup=3, seq_len=128):
     return sps
 
 
+def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=20):
+    """Pallas flash fwd+bwd vs XLA-recompute backward at seq 2048 — the
+    attention-training kernel win (TPU only; interpret mode would measure
+    the emulator)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import (_xla_attention,
+                                                    flash_attention)
+
+    if jax.default_backend() == "cpu":
+        raise RuntimeError("flash bench requires the TPU backend")
+    rng = np.random.RandomState(0)
+    q = jax.device_put(
+        rng.randn(batch, heads, seq, dim).astype(np.float32))
+    k = jax.device_put(
+        rng.randn(batch, heads, seq, dim).astype(np.float32))
+    v = jax.device_put(
+        rng.randn(batch, heads, seq, dim).astype(np.float32))
+
+    flash_g = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(flash_attention(a, b, c, True, None,
+                                                128, 128, False)),
+        argnums=(0, 1, 2)))
+    xla_g = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(_xla_attention(a, b, c, True,
+                                               dim ** -0.5)),
+        argnums=(0, 1, 2)))
+
+    def time_fn(fn):
+        jax.device_get(fn(q, k, v))  # compile + warm
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.device_get(out)
+        return (time.perf_counter() - t0) / iters
+
+    t_flash = time_fn(flash_g)
+    t_xla = time_fn(xla_g)
+    return {"flash_attn_bwd_ms_seq2048": round(t_flash * 1e3, 3),
+            "xla_recompute_bwd_ms_seq2048": round(t_xla * 1e3, 3),
+            "flash_attn_bwd_speedup": round(t_xla / t_flash, 3)}
+
+
 def main():
     which = os.environ.get("PADDLE_TPU_BENCH", "default")
     result = {
@@ -150,6 +195,11 @@ def main():
         v = _try("bert", bench_bert_base)
         if v:
             result["bert_base_samples_per_sec"] = v
+    if which in ("default", "all", "flash"):
+        try:
+            result.update(bench_flash_attention())
+        except Exception as e:  # noqa: BLE001
+            errors["flash"] = str(e)[:200]
     if which in ("default", "all", "mnist") or result["value"] == 0.0:
         v = _try("mnist", bench_mnist_mlp)
         if v:

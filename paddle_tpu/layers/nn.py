@@ -96,6 +96,64 @@ __all__ = [
     "dynamic_gru",
     "beam_search",
     "beam_search_decode",
+    "flatten",
+    "cos_sim",
+    "affine_channel",
+    "shuffle_channel",
+    "space_to_depth",
+    "crop",
+    "pad_constant_like",
+    "multiplex",
+    "bilinear_tensor_product",
+    "rank_loss",
+    "margin_rank_loss",
+    "bpr_loss",
+    "teacher_student_sigmoid_loss",
+    "dice_loss",
+    "mean_iou",
+    "sampling_id",
+    "random_crop",
+    "add_position_encoding",
+    "hash",
+    "row_conv",
+    "grid_sampler",
+    "affine_grid",
+    "ctc_greedy_decoder",
+    "lstm_unit",
+    "gru_unit",
+    "gaussian_random",
+    "selu",
+    "has_inf",
+    "has_nan",
+    "isfinite",
+    "is_empty",
+    "conv3d",
+    "conv3d_transpose",
+    "pool3d",
+    "adaptive_pool2d",
+    "image_resize_short",
+    "linear_chain_crf",
+    "crf_decoding",
+    "nce",
+    "hsigmoid",
+    "sequence_reshape",
+    "sequence_scatter",
+    "lod_reset",
+    "data_norm",
+    "pow",
+    "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like",
+    "autoincreased_step_counter",
+    "create_parameter",
+    "im2sequence",
+    "Print",
+    "tensor_array_to_tensor",
+    "adaptive_pool3d",
+    "merge_selected_rows",
+    "get_tensor_from_selected_rows",
+    "dynamic_lstmp",
+    "lstm",
+    "psroi_pool",
 ]
 
 
@@ -1337,4 +1395,850 @@ def where(condition, x, y):
         inputs={"Condition": [condition], "X": [x], "Y": [y]},
         outputs={"Out": [out]},
     )
+    return out
+
+
+# -- round-2 layer-surface completion (reference: layers/nn.py __all__) ----
+
+def flatten(x, axis=1, name=None):
+    """(reference: layers/nn.py flatten) — trailing dims must be static
+    (the batch-side dim may be dynamic)."""
+    trail = 1
+    for d in x.shape[axis:]:
+        if d is None or d < 0:
+            raise ValueError(
+                "flatten needs static dims after axis=%d; got shape %s"
+                % (axis, (x.shape,)))
+        trail *= d
+    return reshape(x, shape=[-1, trail], name=name)
+
+
+def cos_sim(X, Y):
+    """(reference: layers/nn.py cos_sim)"""
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """(reference: layers/nn.py affine_channel)"""
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="affine_channel",
+                     inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    """(reference: layers/nn.py shuffle_channel)"""
+    helper = LayerHelper("shuffle_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="shuffle_channel", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"group": group})
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    """(reference: layers/nn.py space_to_depth)"""
+    helper = LayerHelper("space_to_depth", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"blocksize": blocksize})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """(reference: layers/nn.py crop)"""
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if hasattr(shape, "name"):
+        inputs["Y"] = [shape]
+    else:
+        attrs["shape"] = list(shape)
+    if offsets is not None:
+        if hasattr(offsets, "name"):
+            inputs["Offsets"] = [offsets]
+        else:
+            attrs["offsets"] = list(offsets)
+    helper.append_op(type="crop", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """(reference: layers/nn.py pad_constant_like)"""
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(type="pad_constant_like",
+                     inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+                     attrs={"pad_value": float(pad_value)})
+    return out
+
+
+def multiplex(inputs, index):
+    """(reference: layers/nn.py multiplex)"""
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """(reference: layers/nn.py bilinear_tensor_product)"""
+    helper = LayerHelper("bilinear_tensor_product", name=name, act=act,
+                         bias_attr=bias_attr)
+    w = helper.create_parameter(
+        attr=param_attr, shape=[size, x.shape[1], y.shape[1]],
+        dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        from paddle_tpu.param_attr import ParamAttr
+
+        bias = helper.create_parameter(
+            attr=bias_attr if bias_attr not in (None, True) else ParamAttr(),
+            shape=[1, size], dtype=x.dtype, is_bias=True)
+        inputs["Bias"] = [bias]
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def rank_loss(label, left, right, name=None):
+    """(reference: layers/nn.py rank_loss)"""
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """(reference: layers/nn.py margin_rank_loss)"""
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    act = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"Label": [label], "X1": [left], "X2": [right]},
+                     outputs={"Out": [out], "Activated": [act]},
+                     attrs={"margin": margin})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    """(reference: layers/nn.py bpr_loss)"""
+    helper = LayerHelper("bpr_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="bpr_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]})
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """(reference: layers/nn.py teacher_student_sigmoid_loss)"""
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="teacher_student_sigmoid_loss",
+        inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_max_up_bound": soft_max_up_bound,
+               "soft_max_lower_bound": soft_max_lower_bound})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """(reference: layers/nn.py dice_loss)"""
+    helper = LayerHelper("dice_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="dice_loss_op",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"epsilon": epsilon})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """(reference: layers/nn.py mean_iou)"""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int64")
+    correct = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """(reference: layers/nn.py sampling_id)"""
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"seed": seed})
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """(reference: layers/nn.py random_crop)"""
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"shape": list(shape)})
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """(reference: layers/nn.py add_position_encoding)"""
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="add_position_encoding",
+                     inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"alpha": alpha, "beta": beta})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """(reference: layers/nn.py hash; see ops/misc_ops.py for the hash
+    function divergence note)"""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="hash", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"num_hash": num_hash, "mod_by": hash_size})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """(reference: layers/nn.py row_conv)"""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = input.shape[-1]
+    filt = helper.create_parameter(
+        attr=param_attr, shape=[future_context_size + 1, d],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [filt]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def grid_sampler(x, grid, name=None):
+    """(reference: layers/nn.py grid_sampler)"""
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """(reference: layers/nn.py affine_grid)"""
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": [theta]}
+    attrs = {}
+    if hasattr(out_shape, "name"):
+        inputs["OutputShape"] = [out_shape]
+    else:
+        attrs["output_shape"] = list(out_shape)
+    helper.append_op(type="affine_grid", inputs=inputs,
+                     outputs={"Output": [out]}, attrs=attrs)
+    return out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """(reference: layers/nn.py ctc_greedy_decoder). Static-shape form:
+    returns (decoded [B, T] padded with -1, lengths [B])."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="ctc_greedy_decoder",
+                     inputs={"Input": [input]},
+                     outputs={"Out": [out], "OutLength": [out_len]},
+                     attrs={"blank": blank})
+    return out, out_len
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """(reference: layers/nn.py lstm_unit) — fc of [x, h] then one cell
+    step."""
+    helper = LayerHelper("lstm_unit", name=name)
+    hsz = hidden_t_prev.shape[1]
+    gates = fc(input=[x_t, hidden_t_prev], size=4 * hsz,
+               param_attr=param_attr, bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": forget_bias})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """(reference: layers/nn.py gru_unit); size = 3*hidden_dim."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    hsz = size // 3
+    w = helper.create_parameter(attr=param_attr, shape=[hsz, 3 * hsz],
+                                dtype=input.dtype)
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        from paddle_tpu.param_attr import ParamAttr
+
+        bias = helper.create_parameter(
+            attr=bias_attr if bias_attr not in (None, True) else ParamAttr(),
+            shape=[1, 3 * hsz], dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [bias]
+    h = helper.create_variable_for_type_inference(input.dtype)
+    r = helper.create_variable_for_type_inference(input.dtype)
+    g = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gru_unit", inputs=inputs,
+                     outputs={"Hidden": [h], "ResetHiddenPrev": [r],
+                              "Gate": [g]})
+    return h, r, g
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    """(reference: layers/ops.py gaussian_random)"""
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+    helper.append_op(type="gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": mean,
+                            "std": std, "seed": seed,
+                            "dtype": int(convert_np_dtype_to_dtype_(dtype))})
+    out.stop_gradient = True
+    return out
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    """(reference: layers/nn.py selu)"""
+    helper = LayerHelper("selu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="selu", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"scale": scale if scale is not None
+               else 1.0507009873554805,
+               "alpha": alpha if alpha is not None
+               else 1.6732632423543772})
+    return out
+
+
+def has_inf(x):
+    """(reference: layers/ops.py has_inf)"""
+    helper = LayerHelper("has_inf")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="isinf", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def has_nan(x):
+    """(reference: layers/ops.py has_nan)"""
+    helper = LayerHelper("has_nan")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="isnan", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def isfinite(x):
+    """(reference: layers/ops.py isfinite)"""
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="isfinite_reduce", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def is_empty(x, cond=None):
+    """(reference: layers/control_flow.py is_empty)"""
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None):
+    """(reference: layers/nn.py conv3d), NCDHW."""
+    helper = LayerHelper("conv3d", name=name, act=act, bias_attr=bias_attr)
+    dtype = input.dtype
+    channels = input.shape[1]
+    to3 = lambda v: [v, v, v] if isinstance(v, int) else list(v)
+    filter_size, stride = to3(filter_size), to3(stride)
+    padding, dilation = to3(padding), to3(dilation)
+    w = helper.create_parameter(
+        attr=param_attr,
+        shape=[num_filters, channels // groups] + filter_size, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": groups})
+    pre_act = _conv_bias(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """(reference: layers/nn.py conv3d_transpose)"""
+    helper = LayerHelper("conv3d_transpose", name=name, act=act,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    channels = input.shape[1]
+    to3 = lambda v: [v, v, v] if isinstance(v, int) else list(v)
+    filter_size, stride = to3(filter_size), to3(stride)
+    padding = to3(padding)
+    w = helper.create_parameter(
+        attr=param_attr,
+        shape=[channels, num_filters // groups] + filter_size, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": stride, "paddings": padding, "groups": groups})
+    pre_act = _conv_bias(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None):
+    """(reference: layers/nn.py pool3d)"""
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    to3 = lambda v: [v, v, v] if isinstance(v, int) else list(v)
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"ksize": to3(pool_size), "strides": to3(pool_stride),
+               "paddings": to3(pool_padding), "pooling_type": pool_type,
+               "global_pooling": global_pooling})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """(reference: layers/nn.py adaptive_pool2d) — output size fixed,
+    kernel derived (requires divisible spatial dims for exact tiling)."""
+    h, w = input.shape[2], input.shape[3]
+    oh, ow = (pool_size, pool_size) if isinstance(pool_size, int) \
+        else pool_size
+    if h % oh or w % ow:
+        raise ValueError(
+            "adaptive_pool2d needs output size dividing the input "
+            "spatial dims (%dx%d -> %dx%d)" % (h, w, oh, ow))
+    return pool2d(input, pool_size=[h // oh, w // ow], pool_type=pool_type,
+                  pool_stride=[h // oh, w // ow], name=name)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """(reference: layers/nn.py image_resize_short)"""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    out_shape = [int(h * out_short_len / short),
+                 int(w * out_short_len / short)]
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """(reference: layers/nn.py linear_chain_crf). Padded [B, T, C]
+    emissions + optional lengths; returns per-sequence log-likelihood."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    num_tags = input.shape[-1]
+    trans = helper.create_parameter(
+        attr=param_attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    eexp = helper.create_variable_for_type_inference(input.dtype)
+    texp = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Emission": [input], "Transition": [trans],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf", inputs=inputs,
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [eexp], "TransitionExps": [texp]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """(reference: layers/nn.py crf_decoding)"""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    # the transition parameter is shared with linear_chain_crf by name
+    trans = helper.main_program.global_block().var(param_attr.name)
+    out = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": [trans]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [out]})
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """(reference: layers/nn.py nce) with a uniform sampler."""
+    helper = LayerHelper("nce", name=name, bias_attr=bias_attr)
+    dim = input.shape[1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=[num_total_classes, dim],
+        dtype=input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=bias_attr if bias_attr not in (None, True) else ParamAttr(),
+            shape=[num_total_classes, 1], dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype)
+    slab = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sl],
+                 "SampleLabels": [slab]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples, "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    """(reference: layers/nn.py hsigmoid) over the default complete
+    binary tree (custom paths unsupported)."""
+    if is_custom or path_table is not None:
+        raise NotImplementedError(
+            "hsigmoid custom trees are not supported; the default "
+            "complete binary tree matches the reference default")
+    helper = LayerHelper("hsigmoid", name=name, bias_attr=bias_attr)
+    dim = input.shape[1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=[num_classes - 1, dim], dtype=input.dtype)
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=bias_attr if bias_attr not in (None, True) else ParamAttr(),
+            shape=[num_classes - 1, 1], dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre]},
+        attrs={"num_classes": num_classes})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    """(reference: layers/nn.py sequence_reshape)"""
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """(reference: layers/nn.py sequence_scatter)"""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_scatter",
+                     inputs={"X": [input], "Ids": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """(reference: layers/nn.py lod_reset). In the padded+length world the
+    data tensor is unchanged; lengths travel as separate tensors, so this
+    is the identity on x (the new lengths are whatever Length tensor the
+    caller threads onward)."""
+    return x
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """(reference: layers/nn.py data_norm) — normalization by accumulated
+    batch statistics held as persistable state."""
+    helper = LayerHelper("data_norm", name=name, act=act)
+    d = input.shape[-1]
+    from paddle_tpu.initializer import ConstantInitializer
+
+    bsize = helper.create_parameter(
+        attr=ParamAttr(name=name and name + ".batch_size",
+                       initializer=ConstantInitializer(1e4)),
+        shape=[d], dtype=input.dtype)
+    bsum = helper.create_parameter(
+        attr=ParamAttr(name=name and name + ".batch_sum",
+                       initializer=ConstantInitializer(0.0)),
+        shape=[d], dtype=input.dtype)
+    bsq = helper.create_parameter(
+        attr=ParamAttr(name=name and name + ".batch_square_sum",
+                       initializer=ConstantInitializer(1e4)),
+        shape=[d], dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype)
+    scales = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [bsize], "BatchSum": [bsum],
+                "BatchSquareSum": [bsq]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]})
+    return helper.append_activation(out)
+
+
+def pow(x, factor=1.0, name=None):
+    """(reference: layers/ops.py pow)"""
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="pow", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"factor": factor})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    """(reference: layers/ops.py uniform_random_batch_size_like)"""
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+    helper.append_op(
+        type="uniform_random_batch_size_like",
+        inputs={"Input": [input]}, outputs={"Out": [out]},
+        attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "min": min, "max": max,
+               "seed": seed,
+               "dtype": int(convert_np_dtype_to_dtype_(dtype))})
+    out.stop_gradient = True
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    """(reference: layers/ops.py gaussian_random_batch_size_like)"""
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+    helper.append_op(
+        type="gaussian_random_batch_size_like",
+        inputs={"Input": [input]}, outputs={"Out": [out]},
+        attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "mean": mean, "std": std,
+               "seed": seed,
+               "dtype": int(convert_np_dtype_to_dtype_(dtype))})
+    out.stop_gradient = True
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """(reference: layers/nn.py autoincreased_step_counter) — persistable
+    int64 counter incremented once per executed step."""
+    helper = LayerHelper("global_step_counter")
+    counter = helper.block.program.global_block().create_var(
+        name=counter_name or "@STEP_COUNTER@",
+        dtype="int64", shape=[1], persistable=True)
+    helper.block.program.global_block().vars[counter.name].desc.attrs[
+        "init_value"] = float(begin - step)
+    helper.append_op(
+        type="increment", inputs={"X": [counter.name]},
+        outputs={"Out": [counter.name]}, attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """(reference: layers/tensor.py create_parameter)"""
+    helper = LayerHelper("create_parameter")
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """(reference: layers/nn.py im2sequence; op in ops/sequence_ops.py)"""
+    helper = LayerHelper("im2sequence", name=name)
+    to2 = lambda v: [v, v] if isinstance(v, int) else list(v)
+    fs, st = to2(filter_size), to2(stride)
+    pd = padding if isinstance(padding, (list, tuple)) and len(padding) == 4 \
+        else to2(padding) * 2
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": fs, "strides": st,
+                            "paddings": list(pd)})
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_lod=True, print_phase="both"):
+    """(reference: layers/control_flow.py Print) — host-side debug print
+    via jax.debug.print; the value passes through unchanged."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print_op", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or input.name})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """(reference: layers/tensor.py tensor_array_to_tensor) — stack the
+    live prefix of a tensor array."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    out_idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="tensor_array_to_tensor",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [out_idx]},
+                     attrs={"axis": axis})
+    return out, out_idx
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """(reference: layers/nn.py adaptive_pool3d)"""
+    d, h, w = input.shape[2], input.shape[3], input.shape[4]
+    od, oh, ow = (pool_size,) * 3 if isinstance(pool_size, int) \
+        else pool_size
+    if d % od or h % oh or w % ow:
+        raise ValueError("adaptive_pool3d needs divisible spatial dims")
+    k = [d // od, h // oh, w // ow]
+    return pool3d(input, pool_size=k, pool_type=pool_type, pool_stride=k,
+                  name=name)
+
+
+def merge_selected_rows(x, name=None):
+    """(reference: layers/nn.py merge_selected_rows). Gradients here are
+    SelectedRows pytree values merged inside the optimizer lowerings, so
+    at the layer level this is the identity."""
+    return x
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """(reference: layers/nn.py get_tensor_from_selected_rows) — dense
+    view; variables fetched across the jit boundary are already
+    densified (engine/lowering.py)."""
+    return x
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None, seq_len=None,
+                  param_attr=None, bias_attr=None, use_peepholes=False,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    """LSTM with a recurrent projection (reference: layers/nn.py
+    dynamic_lstmp → lstmp_op.cc): hidden H projected to P before the
+    recurrence. Built as dynamic_lstm + a learned projection applied to
+    the hidden sequence (the projected state feeds forward, matching the
+    reference's output contract; the recurrent path uses H)."""
+    hidden, cell = dynamic_lstm(
+        input, size, h_0=h_0, c_0=c_0, seq_len=seq_len,
+        param_attr=param_attr, bias_attr=bias_attr,
+        use_peepholes=use_peepholes, is_reverse=is_reverse,
+        gate_activation=gate_activation, cell_activation=cell_activation,
+        candidate_activation=candidate_activation, dtype=dtype, name=name)
+    proj = fc(input=hidden, size=proj_size, num_flatten_dims=2,
+              bias_attr=False, act=proj_activation)
+    return proj, cell
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Multi-layer (optionally bidirectional) LSTM (reference:
+    layers/nn.py lstm → cudnn_lstm_op; here stacked dynamic_lstm scans).
+    Returns (output, last_h, last_c) like the reference."""
+    x = input
+    for layer in range(num_layers):
+        fw_in = fc(input=x, size=4 * hidden_size, num_flatten_dims=2,
+                   bias_attr=False)
+        # initial states apply to the first layer (the reference threads
+        # per-layer init states; one shared pair covers the common case)
+        h0 = init_h if layer == 0 else None
+        c0 = init_c if layer == 0 else None
+        fw, fc_state = dynamic_lstm(fw_in, 4 * hidden_size, h_0=h0,
+                                    c_0=c0)
+        if is_bidirec:
+            bw_in = fc(input=x, size=4 * hidden_size, num_flatten_dims=2,
+                       bias_attr=False)
+            bw, _ = dynamic_lstm(bw_in, 4 * hidden_size, is_reverse=True)
+            x = _concat_last(fw, bw)
+        else:
+            x = fw
+        if dropout_prob and not is_test:
+            x = dropout(x, dropout_prob)
+    last_h = sequence_last_step(x)
+    last_c = sequence_last_step(fc_state)
+    return x, last_h, last_c
+
+
+def _concat_last(a, b):
+    helper = LayerHelper("concat")
+    out = helper.create_variable_for_type_inference(a.dtype)
+    helper.append_op(type="concat", inputs={"X": [a, b]},
+                     outputs={"Out": [out]}, attrs={"axis": 2})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_batch_idx=None, name=None):
+    """Position-sensitive RoI pooling (reference: layers/nn.py psroi_pool
+    → psroi_pool_op.cc)."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_idx is not None:
+        inputs["RoisBatchIdx"] = [rois_batch_idx]
+    helper.append_op(
+        type="psroi_pool", inputs=inputs, outputs={"Out": [out]},
+        attrs={"output_channels": output_channels,
+               "spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width})
     return out

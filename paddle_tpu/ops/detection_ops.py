@@ -238,7 +238,7 @@ def _pairwise_iou(x, y, normalized=True):
     return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
 
 
-@register_op("iou_similarity", grad=None)
+@register_no_grad_op("iou_similarity")
 def iou_similarity(ctx, ins, attrs):
     x = single(ins, "X")
     y = single(ins, "Y")
@@ -364,6 +364,7 @@ def multiclass_nms(ctx, ins, attrs):
     nms_top_k = int(attrs.get("nms_top_k", 400))
     keep_top_k = int(attrs.get("keep_top_k", 100))
     eta = attrs.get("nms_eta", 1.0)
+    normalized = attrs.get("normalized", True)
     B, C, M = scores.shape
     nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
     keep_top_k = keep_top_k if keep_top_k > 0 else C * nms_top_k
@@ -372,7 +373,7 @@ def multiclass_nms(ctx, ins, attrs):
         # top candidates by score
         s, order = lax.top_k(c_scores, nms_top_k)          # [K]
         cand = b_boxes[order]                               # [K, 4]
-        iou = _pairwise_iou(cand, cand)
+        iou = _pairwise_iou(cand, cand, normalized)
         valid = s > score_thr
 
         def body(i, keep):

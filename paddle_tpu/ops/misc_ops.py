@@ -1,0 +1,785 @@
+"""Miscellaneous op lowerings closing the layer-surface gap (reference:
+the corresponding single-op files under paddle/fluid/operators/ — each
+docstring cites its kernel)."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_no_grad_op, register_op
+from paddle_tpu.ops.common import single
+
+
+@register_op("cos_sim")
+def cos_sim(ctx, ins, attrs):
+    """(reference: operators/cos_sim_op.h)"""
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("affine_channel")
+def affine_channel(ctx, ins, attrs):
+    """(reference: operators/affine_channel_op.cc) — NCHW scale/bias per
+    channel."""
+    x = single(ins, "X")
+    scale = single(ins, "Scale").reshape(1, -1, *([1] * (x.ndim - 2)))
+    bias = single(ins, "Bias").reshape(1, -1, *([1] * (x.ndim - 2)))
+    return {"Out": [x * scale + bias]}
+
+
+@register_op("shuffle_channel", no_grad_inputs=())
+def shuffle_channel(ctx, ins, attrs):
+    """(reference: operators/shuffle_channel_op.h)"""
+    x = single(ins, "X")
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(x.shape)
+    return {"Out": [out]}
+
+
+@register_op("space_to_depth")
+def space_to_depth(ctx, ins, attrs):
+    """(reference: operators/space_to_depth_op.cc)"""
+    x = single(ins, "X")
+    bs = int(attrs.get("blocksize", 1))
+    n, c, h, w = x.shape
+    out = (x.reshape(n, c, h // bs, bs, w // bs, bs)
+           .transpose(0, 3, 5, 1, 2, 4)
+           .reshape(n, c * bs * bs, h // bs, w // bs))
+    return {"Out": [out]}
+
+
+@register_op("crop", no_grad_inputs=("Offsets", "Y"))
+def crop(ctx, ins, attrs):
+    """(reference: operators/crop_op.h)"""
+    x = single(ins, "X")
+    shape = attrs.get("shape")
+    y = ins.get("Y", [None])
+    if y and y[0] is not None:
+        shape = y[0].shape
+    off_in = ins.get("Offsets", [None])
+    if off_in and off_in[0] is not None:
+        # tensor offsets: dynamic slice (stays traceable)
+        starts = [off_in[0][i].astype(jnp.int32) for i in range(x.ndim)]
+        return {"Out": [lax.dynamic_slice(x, starts, list(shape))]}
+    offsets = attrs.get("offsets") or [0] * x.ndim
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[sl]]}
+
+
+@register_op("pad_constant_like", no_grad_inputs=("X",))
+def pad_constant_like(ctx, ins, attrs):
+    """(reference: operators/pad_constant_like_op.cc) — pad Y up to X's
+    shape."""
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    pad_value = attrs.get("pad_value", 0.0)
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=pad_value)]}
+
+
+@register_op("multiplex", no_grad_inputs=("Ids",))
+def multiplex(ctx, ins, attrs):
+    """(reference: operators/multiplex_op.cc): out[i] = X[ids[i]][i]."""
+    xs = jnp.stack(ins.get("X", []))          # [K, B, D]
+    ids = single(ins, "Ids").reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": [xs[ids, rows]]}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins, attrs):
+    """(reference: operators/bilinear_tensor_product_op.h):
+    out[b, k] = x[b] @ W[k] @ y[b] + bias[k]."""
+    x = single(ins, "X")                      # [B, M]
+    y = single(ins, "Y")                      # [B, N]
+    w = single(ins, "Weight")                 # [K, M, N]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    bias = ins.get("Bias", [None])
+    if bias and bias[0] is not None:
+        out = out + bias[0].reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register_op("rank_loss", no_grad_inputs=("Label",))
+def rank_loss(ctx, ins, attrs):
+    """(reference: operators/rank_loss_op.cc)"""
+    label = single(ins, "Label")
+    left = single(ins, "Left")
+    right = single(ins, "Right")
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register_op("margin_rank_loss", no_grad_inputs=("Label",))
+def margin_rank_loss(ctx, ins, attrs):
+    """(reference: operators/margin_rank_loss_op.h)"""
+    label = single(ins, "Label")
+    x1 = single(ins, "X1")
+    x2 = single(ins, "X2")
+    margin = attrs.get("margin", 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [act], "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+@register_op("bpr_loss", no_grad_inputs=("Label",))
+def bpr_loss(ctx, ins, attrs):
+    """Bayesian Personalized Ranking (reference: operators/bpr_loss_op.h):
+    -mean_j log(sigmoid(x_label - x_j))."""
+    x = single(ins, "X")                      # [B, C]
+    label = single(ins, "Label").reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    diff = pos - x                            # [B, C]
+    lsig = -jnp.log1p(jnp.exp(-diff))
+    c = x.shape[1]
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    loss = -jnp.sum(jnp.where(mask, lsig, 0.0), axis=1,
+                    keepdims=True) / (c - 1)
+    return {"Y": [loss]}
+
+
+@register_op("teacher_student_sigmoid_loss", no_grad_inputs=("Label",))
+def teacher_student_sigmoid_loss(ctx, ins, attrs):
+    """(reference: operators/teacher_student_sigmoid_loss_op.cc)"""
+    x = single(ins, "X").reshape(-1)
+    label = single(ins, "Label").reshape(-1)
+    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
+    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    # teacher (label < -1 or > 1 carries a soft target): the reference
+    # mixes hard ctr loss and soft teacher loss by the label's range
+    hard = jnp.log1p(jnp.exp(z)) - jnp.where(label > 0.0, z, 0.0)
+    soft = jnp.log1p(jnp.exp(z)) - label * z
+    loss = jnp.where((label < 0.0) | (label > 1.0), soft, hard)
+    return {"Y": [loss.reshape(-1, 1)]}
+
+
+@register_op("dice_loss_op", no_grad_inputs=("Label",))
+def dice_loss_op(ctx, ins, attrs):
+    """(reference: python-side layers/nn.py dice_loss composition)"""
+    x = single(ins, "X")
+    label = single(ins, "Label").astype(x.dtype)
+    eps = attrs.get("epsilon", 1e-5)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * label, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label, axis=reduce_dims)
+    return {"Out": [jnp.mean(1.0 - (2 * inter + eps) / (union + eps))]}
+
+
+@register_no_grad_op("mean_iou")
+def mean_iou(ctx, ins, attrs):
+    """(reference: operators/mean_iou_op.h)"""
+    pred = single(ins, "Predictions").reshape(-1).astype(jnp.int32)
+    label = single(ins, "Labels").reshape(-1).astype(jnp.int32)
+    c = int(attrs["num_classes"])
+    cls = jnp.arange(c)[:, None]
+    is_p = pred[None, :] == cls
+    is_l = label[None, :] == cls
+    inter = jnp.sum(is_p & is_l, axis=1).astype(jnp.float32)
+    union = jnp.sum(is_p | is_l, axis=1).astype(jnp.float32)
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    return {"OutMeanIou": [mean], "OutWrong": [jnp.sum(is_p & ~is_l, 1)],
+            "OutCorrect": [inter.astype(jnp.int64)]}
+
+
+@register_no_grad_op("sampling_id", needs_rng=True)
+def sampling_id(ctx, ins, attrs):
+    """(reference: operators/sampling_id_op.h) — sample one id per row
+    from a probability matrix."""
+    x = single(ins, "X")
+    ids = jax.random.categorical(ctx.rng(), jnp.log(jnp.maximum(x, 1e-20)),
+                                 axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register_no_grad_op("random_crop", needs_rng=True)
+def random_crop(ctx, ins, attrs):
+    """(reference: operators/random_crop_op.h) — random spatial crop of
+    the trailing dims to attr shape."""
+    x = single(ins, "X")
+    shape = attrs["shape"]
+    lead = x.ndim - len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(limit, 0) + 1))
+    idx = tuple([slice(None)] * lead)
+    out = lax.dynamic_slice(
+        x, [0] * lead + [s for s in starts],
+        list(x.shape[:lead]) + list(shape))
+    del idx
+    return {"Out": [out]}
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(ctx, ins, attrs):
+    """(reference: operators/add_position_encoding_op.h) — sinusoidal."""
+    x = single(ins, "X")                      # [B, T, D]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = d // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return {"Out": [alpha * x + beta * pe[None, :, :d].astype(x.dtype)]}
+
+
+@register_no_grad_op("hash")
+def hash_op(ctx, ins, attrs):
+    """(reference: operators/hash_op.h uses xxhash; here a documented
+    splitmix64-style mix — deterministic, well-spread, but NOT the same
+    hash values as the reference)."""
+    x = single(ins, "X").astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 100000))
+    outs = []
+    for k in range(num_hash):
+        h = x * jnp.uint32(0x9E3779B1) + jnp.uint32(k * 0x85EBCA6B)
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 13)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return {"Out": [jnp.stack(outs, axis=-2)]}
+
+
+@register_op("row_conv", no_grad_inputs=())
+def row_conv(ctx, ins, attrs):
+    """Lookahead convolution (reference: operators/row_conv_op.cc):
+    out[b, t] = sum_k x[b, t+k] * filt[k] over the future window."""
+    x = single(ins, "X")                      # [B, T, D]
+    filt = single(ins, "Filter")              # [future_len, D]
+    k = filt.shape[0]
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
+        out = out + shifted * filt[i][None, None, :]
+    return {"Out": [out]}
+
+
+@register_op("grid_sampler", no_grad_inputs=())
+def grid_sampler(ctx, ins, attrs):
+    """Bilinear grid sampling (reference: operators/grid_sampler_op.h):
+    grid in [-1, 1], NCHW input."""
+    x = single(ins, "X")                      # [N, C, H, W]
+    grid = single(ins, "Grid")                # [N, H', W', 2] (x, y)
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, w - 1)
+    y0 = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        # [N, H', W'] indices into [N, C, H, W] -> [N, C, H', W']
+        flat = yi * w + xi                     # [N, H', W']
+        xr = x.reshape(n, c, h * w)
+        return jnp.take_along_axis(
+            xr, flat[:, None, :, :].reshape(n, 1, -1), axis=2
+        ).reshape(n, c, *flat.shape[1:])
+
+    v00, v01 = gather(y0, x0), gather(y0, x1)
+    v10, v11 = gather(y1, x0), gather(y1, x1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    out = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+           + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    return {"Output": [out]}
+
+
+@register_op("affine_grid", no_grad_inputs=())
+def affine_grid(ctx, ins, attrs):
+    """(reference: operators/affine_grid_op.h): theta [N, 2, 3] ->
+    sampling grid [N, H, W, 2] over the normalized output size."""
+    theta = single(ins, "Theta")
+    out_shape = attrs.get("output_shape")
+    shape_in = ins.get("OutputShape", [None])
+    if shape_in and shape_in[0] is not None:
+        try:
+            out_shape = [int(v) for v in jax.device_get(shape_in[0])]
+        except Exception as e:
+            raise ValueError(
+                "affine_grid needs a STATIC output shape under jit — "
+                "pass output_shape as an attr/python list") from e
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return {"Output": [grid]}
+
+
+@register_no_grad_op("ctc_greedy_decoder")
+def ctc_greedy_decoder(ctx, ins, attrs):
+    """Greedy CTC decode (reference: the ctc_align_op.cu kernel behind
+    layers/nn.py ctc_greedy_decoder): argmax per step, collapse repeats,
+    drop blanks. Static-shape: output padded with -1 + per-row lengths."""
+    x = single(ins, "Input")                  # [B, T, C] probs/logits
+    blank = int(attrs.get("blank", 0))
+    ids = jnp.argmax(x, axis=-1).astype(jnp.int32)   # [B, T]
+    prev = jnp.concatenate(
+        [jnp.full((ids.shape[0], 1), -1, jnp.int32), ids[:, :-1]], axis=1)
+    keep = (ids != blank) & (ids != prev)
+    # left-compact kept ids: position = cumsum(keep) - 1; dropped entries
+    # contribute -1 through a scatter-max, which never beats a kept id
+    pos = jnp.cumsum(keep, axis=1) - 1
+    t = ids.shape[1]
+    rows = jnp.arange(ids.shape[0])[:, None]
+    out = jnp.full(ids.shape, -1, jnp.int32).at[
+        rows, jnp.clip(pos, 0, t - 1)].max(
+        jnp.where(keep, ids, -1), mode="drop")
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int64)
+    return {"Out": [out.astype(jnp.int64)], "OutLength": [lengths]}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx, ins, attrs):
+    """Single LSTM step (reference: operators/lstm_unit_op.h): gates
+    [B, 4H] pre-computed, order i, f, c_hat, o."""
+    gates = single(ins, "X")
+    c_prev = single(ins, "C_prev")
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, f, c_hat, o = jnp.split(gates, 4, axis=1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + jax.nn.sigmoid(
+        i) * jnp.tanh(c_hat)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("gru_unit")
+def gru_unit(ctx, ins, attrs):
+    """Single GRU step (reference: operators/gru_unit_op.h)."""
+    x = single(ins, "Input")                  # [B, 3H] projected input
+    h_prev = single(ins, "HiddenPrev")        # [B, H]
+    w = single(ins, "Weight")                 # [H, 3H]
+    bias = ins.get("Bias", [None])
+    if bias and bias[0] is not None:
+        x = x + bias[0]
+    hsz = h_prev.shape[1]
+    w_g, w_c = w[:, :2 * hsz], w[:, 2 * hsz:]
+    gates = x[:, :2 * hsz] + h_prev @ w_g
+    u = jax.nn.sigmoid(gates[:, :hsz])
+    r = jax.nn.sigmoid(gates[:, hsz:])
+    c = jnp.tanh(x[:, 2 * hsz:] + (r * h_prev) @ w_c)
+    h = u * h_prev + (1.0 - u) * c
+    return {"Hidden": [h], "ResetHiddenPrev": [r * h_prev], "Gate": [gates]}
+
+
+@register_op("selu")
+def selu(ctx, ins, attrs):
+    """(reference: operators/selu_op.h)"""
+    x = single(ins, "X")
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))]}
+
+
+@register_no_grad_op("isinf")
+def isinf(ctx, ins, attrs):
+    """(reference: operators/isfinite_op.cc OverflowKernel)"""
+    return {"Out": [jnp.isinf(single(ins, "X")).any().reshape(1)]}
+
+
+@register_no_grad_op("isnan")
+def isnan(ctx, ins, attrs):
+    return {"Out": [jnp.isnan(single(ins, "X")).any().reshape(1)]}
+
+
+@register_no_grad_op("isfinite_reduce")
+def isfinite_reduce(ctx, ins, attrs):
+    return {"Out": [jnp.isfinite(single(ins, "X")).all().reshape(1)]}
+
+
+@register_no_grad_op("is_empty")
+def is_empty(ctx, ins, attrs):
+    """(reference: operators/is_empty_op.cc)"""
+    x = single(ins, "X")
+    return {"Out": [jnp.asarray([x.size == 0])]}
+
+
+@register_op("conv3d")
+def conv3d(ctx, ins, attrs):
+    """NCDHW 3-D convolution (reference: operators/conv_op.cc conv3d)."""
+    x = single(ins, "Input")
+    w = single(ins, "Filter")                 # [O, I/g, KD, KH, KW]
+    strides = attrs.get("strides", [1, 1, 1])
+    pads = attrs.get("paddings", [0, 0, 0])
+    dilations = attrs.get("dilations", [1, 1, 1])
+    groups = int(attrs.get("groups", 1))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        preferred_element_type=jnp.float32)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx, ins, attrs):
+    """Gradient-style transposed 3-D conv, mirroring conv2d_transpose
+    (reference: operators/conv_transpose_op.cc; output size
+    (D-1)*s - 2p + d*(k-1) + 1): input-dilate by stride, convolve with
+    the spatially-flipped, IO-swapped kernel."""
+    x = single(ins, "Input")                  # NCDHW
+    w = single(ins, "Filter")                 # [I, O/g, KD, KH, KW]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1))
+
+    c_in, o_g = w.shape[0], w.shape[1]
+    ks = w.shape[2:]
+    w_ = w.reshape((groups, c_in // groups, o_g) + ks)
+    w_ = jnp.moveaxis(w_, 2, 1).reshape((groups * o_g, c_in // groups) + ks)
+    w_ = jnp.flip(w_, axis=(2, 3, 4))
+    pad = [(dilations[i] * (ks[i] - 1) - pads[i],) * 2 for i in range(3)]
+    out = lax.conv_general_dilated(
+        x, w_, window_strides=(1, 1, 1), padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("pool3d")
+def pool3d(ctx, ins, attrs):
+    """(reference: operators/pool_op.cc pool3d)"""
+    x = single(ins, "X")
+    ksize = attrs.get("ksize", [1, 1, 1])
+    strides = attrs.get("strides", ksize)
+    pads = attrs.get("paddings", [0, 0, 0])
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides, pads = ksize, [0, 0, 0]
+    dims = (1, 1) + tuple(ksize)
+    strd = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, dims, strd, padding)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strd, padding)
+        n = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strd,
+                              padding)
+        out = s / n
+    return {"Out": [out]}
+
+
+@register_op("linear_chain_crf",
+             no_grad_inputs=("Label", "Length"))
+def linear_chain_crf(ctx, ins, attrs):
+    """Linear-chain CRF negative log-likelihood (reference:
+    operators/linear_chain_crf_op.h on LoD batches; here padded [B, T, C]
+    + Length). Transition layout matches the reference: row 0 = start
+    scores, row 1 = end scores, rows 2.. = [C, C] transitions."""
+    em = single(ins, "Emission").astype(jnp.float32)   # [B, T, C]
+    trans = single(ins, "Transition").astype(jnp.float32)  # [C+2, C]
+    label = single(ins, "Label")
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)                    # [B, T]
+    B, T, C = em.shape
+    lens = ins.get("Length", [None])
+    lens = (lens[0].reshape(-1).astype(jnp.int32)
+            if lens and lens[0] is not None
+            else jnp.full((B,), T, jnp.int32))
+    start, end, tr = trans[0], trans[1], trans[2:]
+
+    # gold path score
+    first_lab = label[:, 0]
+    gold0 = start[first_lab] + em[:, 0][jnp.arange(B), first_lab]
+
+    def gold_step(carry, inp):
+        score, prev_lab = carry
+        em_t, lab_t, t = inp
+        step = tr[prev_lab, lab_t] + em_t[jnp.arange(B), lab_t]
+        valid = t < lens
+        score = jnp.where(valid, score + step, score)
+        prev_lab = jnp.where(valid, lab_t, prev_lab)
+        return (score, prev_lab), None
+
+    (gold, last_lab), _ = lax.scan(
+        gold_step, (gold0, first_lab),
+        (jnp.moveaxis(em[:, 1:], 1, 0), jnp.moveaxis(label[:, 1:], 1, 0),
+         jnp.arange(1, T)))
+    gold = gold + end[last_lab]
+
+    # partition function
+    alpha0 = start[None, :] + em[:, 0]                 # [B, C]
+
+    def fwd(alpha, inp):
+        em_t, t = inp
+        new = jax.nn.logsumexp(
+            alpha[:, :, None] + tr[None], axis=1) + em_t
+        return jnp.where((t < lens)[:, None], new, alpha), None
+
+    alpha, _ = lax.scan(fwd, alpha0,
+                        (jnp.moveaxis(em[:, 1:], 1, 0), jnp.arange(1, T)))
+    logz = jax.nn.logsumexp(alpha + end[None, :], axis=1)
+    nll = (logz - gold).reshape(B, 1)
+    return {"LogLikelihood": [-nll], "Alpha": [alpha],
+            "EmissionExps": [jnp.exp(em)],
+            "TransitionExps": [jnp.exp(trans)]}
+
+
+@register_no_grad_op("crf_decoding")
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (reference: operators/crf_decoding_op.h). Output:
+    best path [B, T] (zeros past each length); with Label given, emits
+    per-position mismatch like the reference (1 where path == label)."""
+    em = single(ins, "Emission").astype(jnp.float32)
+    trans = single(ins, "Transition").astype(jnp.float32)
+    B, T, C = em.shape
+    lens = ins.get("Length", [None])
+    lens = (lens[0].reshape(-1).astype(jnp.int32)
+            if lens and lens[0] is not None
+            else jnp.full((B,), T, jnp.int32))
+    start, end, tr = trans[0], trans[1], trans[2:]
+
+    def step(carry, inp):
+        score, t = carry, inp[1]
+        em_t = inp[0]
+        cand = score[:, :, None] + tr[None]            # [B, C, C]
+        best = jnp.max(cand, axis=1) + em_t
+        ptr = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        new = jnp.where((t < lens)[:, None], best, score)
+        ptr = jnp.where((t < lens)[:, None], ptr,
+                        jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32),
+                                         (B, C)))
+        return new, ptr
+
+    score0 = start[None] + em[:, 0]
+    final, ptrs = lax.scan(
+        step, score0, (jnp.moveaxis(em[:, 1:], 1, 0), jnp.arange(1, T)))
+    # add end scores at each row's final step
+    last = jnp.argmax(final + end[None], axis=1).astype(jnp.int32)
+
+    def back(lab, ptr_t):
+        # ptr at step t maps the label at t to the label at t-1; emitting
+        # prev yields, in scan-reverse order, the labels for times 0..T-2
+        prev = ptr_t[jnp.arange(B), lab]
+        return prev, prev
+
+    _, path_rev = lax.scan(back, last, ptrs, reverse=True)
+    path = jnp.concatenate(
+        [jnp.moveaxis(path_rev, 0, 1),
+         last[:, None]], axis=1)                        # [B, T]
+    mask = jnp.arange(T)[None, :] < lens[:, None]
+    path = jnp.where(mask, path, 0)
+    out = {"ViterbiPath": [path.astype(jnp.int64)]}
+    label = ins.get("Label", [None])
+    if label and label[0] is not None:
+        lab = label[0]
+        if lab.ndim == 3 and lab.shape[-1] == 1:
+            lab = lab[..., 0]
+        out["ViterbiPath"] = [
+            (jnp.where(mask, path == lab.astype(path.dtype), 0)
+             ).astype(jnp.int64)]
+    return out
+
+
+@register_op("nce", no_grad_inputs=("Label", "SampleWeight"),
+             needs_rng=True)
+def nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (reference: operators/nce_op.h) with
+    a uniform noise sampler."""
+    x = single(ins, "Input")                  # [B, D]
+    label = single(ins, "Label").reshape(-1).astype(jnp.int32)
+    w = single(ins, "Weight")                 # [C, D]
+    bias = ins.get("Bias", [None])
+    bias = bias[0] if bias and bias[0] is not None else None
+    k = int(attrs.get("num_neg_samples", 10))
+    C = int(attrs.get("num_total_classes", w.shape[0]))
+    B = x.shape[0]
+    neg = jax.random.randint(ctx.rng(), (B, k), 0, C)
+
+    def logits(ids):
+        s = jnp.einsum("bd,bkd->bk", x, w[ids])
+        if bias is not None:
+            s = s + bias.reshape(-1)[ids]
+        return s
+
+    log_q = -jnp.log(float(C))                # uniform noise
+    pos = logits(label[:, None]) - (jnp.log(float(k)) + log_q)
+    negs = logits(neg) - (jnp.log(float(k)) + log_q)
+    loss = (-jax.nn.log_sigmoid(pos).reshape(B)
+            - jnp.sum(jax.nn.log_sigmoid(-negs), axis=1))
+    return {"Cost": [loss.reshape(B, 1)],
+            "SampleLogits": [jnp.concatenate([pos, negs], 1)],
+            "SampleLabels": [jnp.concatenate(
+                [label[:, None], neg], 1).astype(jnp.int64)]}
+
+
+@register_op("hierarchical_sigmoid", no_grad_inputs=("Label",))
+def hierarchical_sigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: operators/hierarchical_sigmoid_op.h + math/matrix_bit_code):
+    class c walks node (c + num_classes) up to the root; internal node n
+    uses weight row n-1."""
+    x = single(ins, "X")                      # [B, D]
+    w = single(ins, "W")                      # [C-1, D] internal nodes
+    label = single(ins, "Label").reshape(-1).astype(jnp.int32)
+    bias = ins.get("Bias", [None])
+    bias = bias[0] if bias and bias[0] is not None else None
+    C = int(attrs["num_classes"])
+    B = x.shape[0]
+    import math
+
+    max_depth = max(1, math.ceil(math.log2(C)))
+
+    node = label + C
+    loss = jnp.zeros((B,), jnp.float32)
+    for _ in range(max_depth):
+        valid = node > 1
+        code = (node % 2).astype(jnp.float32)  # 1 = right child
+        parent = jnp.clip(node // 2, 1, 2 * C - 1)
+        row = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+        s = jnp.einsum("bd,bd->b", x, w[row])
+        if bias is not None:
+            s = s + bias.reshape(-1)[row]
+        # sigmoid cross entropy with the path bit as label, the
+        # reference's convention (math/matrix_bit_code.h:
+        # loss = softplus(s) - bit * s) so imported reference weights
+        # keep their sign
+        step_loss = jnp.logaddexp(0.0, s) - code * s
+        loss = loss + jnp.where(valid, step_loss, 0.0)
+        node = parent
+    return {"Out": [loss.reshape(B, 1)],
+            "PreOut": [jnp.zeros((B, max_depth), x.dtype)]}
+
+
+@register_op("sequence_reshape", no_grad_inputs=())
+def sequence_reshape(ctx, ins, attrs):
+    """(reference: sequence_ops/sequence_reshape_op.cc): refold the time
+    x feature dims to a new feature width."""
+    x = single(ins, "X")                      # [B, T, D]
+    new_dim = int(attrs["new_dim"])
+    b, t, d = x.shape
+    return {"Out": [x.reshape(b, t * d // new_dim, new_dim)]}
+
+
+@register_op("sequence_scatter", no_grad_inputs=("Ids", "Length"))
+def sequence_scatter(ctx, ins, attrs):
+    """(reference: sequence_ops/sequence_scatter_op.cc): per-row scatter-
+    add of Updates at Ids into X."""
+    x = single(ins, "X")                      # [B, N]
+    ids = single(ins, "Ids").astype(jnp.int32)   # [B, T]
+    upd = single(ins, "Updates")              # [B, T]
+    rows = jnp.arange(x.shape[0])[:, None]
+    return {"Out": [x.at[rows, ids].add(upd, mode="drop")]}
+
+
+@register_op("data_norm", no_grad_inputs=())
+def data_norm(ctx, ins, attrs):
+    """(reference: operators/data_norm_op.cc): normalize by accumulated
+    batch statistics (size/sum/square-sum accumulators)."""
+    x = single(ins, "X")
+    bsize = single(ins, "BatchSize")
+    bsum = single(ins, "BatchSum")
+    bsq = single(ins, "BatchSquareSum")
+    mean = bsum / jnp.maximum(bsize, 1e-4)
+    var = bsq / jnp.maximum(bsize, 1e-4) - mean * mean
+    scale = 1.0 / jnp.sqrt(jnp.maximum(var, 1e-4))
+    out = (x - mean[None]) * scale[None]
+    return {"Y": [out], "Means": [mean], "Scales": [scale]}
+
+
+@register_no_grad_op("uniform_random_batch_size_like", needs_rng=True)
+def uniform_random_batch_size_like(ctx, ins, attrs):
+    """(reference: operators/uniform_random_batch_size_like_op.cc)"""
+    ref = single(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[int(attrs.get("output_dim_idx", 0))] = ref.shape[
+        int(attrs.get("input_dim_idx", 0))]
+    out = jax.random.uniform(ctx.rng(), tuple(shape),
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": [out]}
+
+
+@register_no_grad_op("gaussian_random_batch_size_like", needs_rng=True)
+def gaussian_random_batch_size_like(ctx, ins, attrs):
+    """(reference: operators/gaussian_random_batch_size_like_op.cc)"""
+    ref = single(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[int(attrs.get("output_dim_idx", 0))] = ref.shape[
+        int(attrs.get("input_dim_idx", 0))]
+    out = (jax.random.normal(ctx.rng(), tuple(shape))
+           * attrs.get("std", 1.0) + attrs.get("mean", 0.0))
+    return {"Out": [out]}
+
+
+@register_op("print_op")
+def print_op(ctx, ins, attrs):
+    """(reference: operators/print_op.cc) — host callback print; value
+    passes through."""
+    x = single(ins, "X")
+    jax.debug.print(str(attrs.get("message", "")) + " {}", x)
+    return {"Out": [x]}
+
+
+@register_no_grad_op("tensor_array_to_tensor")
+def tensor_array_to_tensor(ctx, ins, attrs):
+    """(reference: operators/tensor_array_to_tensor_op.cc) — stack/concat
+    the array's buffer along axis; entries past the live length are
+    zeros (fixed-capacity arrays, see controlflow_ops.py)."""
+    arr = single(ins, "X")
+    axis = int(attrs.get("axis", 1))
+    buf = arr["buf"]                           # [cap, ...]
+    # CONCAT semantics on every axis (reference concatenates entries):
+    # cap entries of [B, D] -> axis 0: [cap*B, D]; axis 1: [B, cap*D]
+    out = jnp.concatenate([buf[i] for i in range(buf.shape[0])],
+                          axis=axis)
+    return {"Out": [out],
+            "OutIndex": [jnp.reshape(arr["len"], (1,)).astype(jnp.int64)]}
+
+
+@register_op("psroi_pool", no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def psroi_pool(ctx, ins, attrs):
+    """(reference: operators/psroi_pool_op.h): input channels are
+    output_channels * ph * pw; bin (i, j) of output channel c averages
+    input channel c*ph*pw + i*pw + j over the bin's region."""
+    x = single(ins, "X")                       # [N, C*ph*pw, H, W]
+    rois = single(ins, "ROIs")
+    bidx = ins.get("RoisBatchIdx", [None])
+    bidx = bidx[0] if bidx and bidx[0] is not None else jnp.zeros(
+        (rois.shape[0],), jnp.int32)
+    oc = int(attrs["output_channels"])
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+    ratio = 4
+
+    def one_roi(roi, bi):
+        img = x[bi]
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        gy = jnp.clip(y1 + (jnp.arange(ph * ratio) + 0.5) * rh
+                      / (ph * ratio), 0, H - 1).astype(jnp.int32)
+        gx = jnp.clip(x1 + (jnp.arange(pw * ratio) + 0.5) * rw
+                      / (pw * ratio), 0, W - 1).astype(jnp.int32)
+        samp = img[:, gy][:, :, gx].reshape(C, ph, ratio, pw, ratio)
+        pooled = samp.mean(axis=(2, 4))        # [C, ph, pw]
+        # position-sensitive channel selection
+        pooled = pooled.reshape(oc, ph, pw, ph, pw)
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        return pooled[:, ii, jj, ii, jj]
+
+    out = jax.vmap(one_roi)(rois, bidx.astype(jnp.int32))
+    return {"Out": [out]}

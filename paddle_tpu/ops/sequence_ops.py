@@ -21,7 +21,9 @@ def _mask(lengths, max_len, dtype=jnp.float32):
 @register_op("sequence_pool", no_grad_inputs=("Length",))
 def sequence_pool(ctx, ins, attrs):
     x = single(ins, "X")  # [B, T, D] padded
-    lengths = single(ins, "Length")  # [B]
+    lengths = single(ins, "Length")  # [B]; absent = every row full
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
     pooltype = attrs.get("pooltype", "SUM").upper()
     mask = _mask(lengths, x.shape[1], x.dtype)[..., None]
     if pooltype == "SUM":

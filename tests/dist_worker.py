@@ -1,0 +1,107 @@
+"""Subprocess worker for the real-process distributed test (the
+reference's bar: tests/unittests/test_dist_base.py:213 spawns actual
+pserver/trainer processes, not threads). Role and topology come from
+env vars, results go to stdout as JSON."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build(lr=0.1):
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="sw1"),
+                            bias_attr=False)
+        pred = fluid.layers.fc(input=h, size=4,
+                               param_attr=fluid.ParamAttr(name="sw2"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    init = {
+        "sw1": np.linspace(-0.4, 0.4, 16 * 16).astype(
+            np.float32).reshape(16, 16),
+        "sw2": np.linspace(0.3, -0.3, 16 * 4).astype(
+            np.float32).reshape(16, 4),
+    }
+    return main, startup, loss, init
+
+
+def batches(n, batch, seed=0):
+    import numpy as np
+
+    W = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    rng = np.random.RandomState(seed + 100)
+    out = []
+    for _ in range(n):
+        xv = rng.randn(batch, 16).astype(np.float32)
+        yv = np.argmax(xv @ W, 1).astype(np.int64).reshape(-1, 1)
+        out.append({"x": xv, "y": yv})
+    return out
+
+
+def main():
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.ps import DistTrainer, ParameterServer
+
+    role = os.environ["PADDLE_ROLE"]
+    eps = os.environ["PADDLE_PSERVER_EPS"]
+    trainers = int(os.environ["PADDLE_TRAINERS"])
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    n_steps = int(os.environ.get("PADDLE_STEPS", "6"))
+
+    main_prog, startup, loss, init = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=trainer_id, program=main_prog, pservers=eps,
+                trainers=trainers, startup_program=startup)
+
+    if role == "PSERVER":
+        ep = os.environ["PADDLE_CURRENT_EP"]
+        srv = ParameterServer(t.get_pserver_program(ep), startup, ep,
+                              fanin=trainers)
+        for k, v in init.items():
+            srv.scope.set(k, v)
+        print("READY", flush=True)
+        srv.serve_forever()
+        # after shutdown, dump owned params for the test to compare
+        out = {n: np.asarray(srv.scope.get(n)).tolist()
+               for n in ("sw1", "sw2") if srv.scope.get(n) is not None
+               and n in t._param_to_ep and t._param_to_ep[n] == ep}
+        print("PARAMS " + json.dumps(out), flush=True)
+        return
+
+    trainer = DistTrainer(t.get_trainer_program(), t)
+    trainer.run_startup(startup)
+    trainer.pull_params()
+    half = 16
+    losses = []
+    for b in batches(n_steps, 2 * half):
+        sl = slice(trainer_id * half, (trainer_id + 1) * half)
+        (l,) = trainer.run({"x": b["x"][sl], "y": b["y"][sl]},
+                           [loss.name])
+        losses.append(float(np.asarray(l)))
+    trainer.close()
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Distributed training with REAL pserver/trainer subprocesses — the
+reference's cluster-simulation discipline (reference:
+tests/unittests/test_dist_base.py:213 start_pserver + run_trainer in
+separate processes), closing the thread-based test's GIL blind spot."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_subprocess_cluster_matches_local():
+    n_steps = 6
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    env_base = dict(
+        os.environ,
+        PADDLE_PSERVER_EPS=",".join(eps),
+        PADDLE_TRAINERS="2",
+        PADDLE_STEPS=str(n_steps),
+        JAX_PLATFORMS="cpu",
+    )
+    worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
+
+    pservers = []
+    for ep in eps:
+        env = dict(env_base, PADDLE_ROLE="PSERVER", PADDLE_CURRENT_EP=ep)
+        pservers.append(subprocess.Popen(
+            [sys.executable, worker], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    # wait for both servers to bind
+    for p in pservers:
+        line = p.stdout.readline().strip()
+        assert line == "READY", (line, p.stderr.read())
+
+    trainers = []
+    for tid in range(2):
+        env = dict(env_base, PADDLE_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(tid))
+        trainers.append(subprocess.Popen(
+            [sys.executable, worker], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+
+    results = []
+    for p in trainers:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-2000:]
+        for line in out.splitlines():
+            if line.startswith("LOSSES "):
+                results.append(json.loads(line[len("LOSSES "):]))
+    assert len(results) == 2, results
+    for p in pservers:
+        try:
+            p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+    # local oracle: same model, same init, full batches
+    sys.path.insert(0, os.path.dirname(__file__))
+    from dist_worker import batches, build
+
+    main, startup, loss, init = build()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for k, v in init.items():
+            scope.set(k, v)
+        local_losses = []
+        for b in batches(n_steps, 32):
+            (l,) = exe.run(main, feed=b, fetch_list=[loss], scope=scope)
+            local_losses.append(float(np.asarray(l)))
+
+    dist_losses = [(a + b) / 2 for a, b in zip(*results)]
+    np.testing.assert_allclose(dist_losses, local_losses, rtol=1e-4,
+                               atol=1e-5)
+    assert dist_losses[-1] < dist_losses[0]

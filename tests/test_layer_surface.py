@@ -1,0 +1,331 @@
+"""Round-2 layer-surface completion tests: CRF vs brute-force oracle,
+NCE/hsigmoid training, and numpy oracles for the misc op batch
+(reference unittests: test_linear_chain_crf_op.py, test_crf_decoding_op,
+test_nce.py, test_hsigmoid_op.py, test_multiplex_op.py, ...)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _run(build, feed):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed, fetch_list=list(fetch))
+    return [np.asarray(o) for o in outs]
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    B, T, C = 2, 4, 3
+    rng = np.random.RandomState(0)
+    em = rng.randn(B, T, C).astype(np.float32)
+    trans = rng.randn(C + 2, C).astype(np.float32) * 0.3
+    label = rng.randint(0, C, (B, T)).astype(np.int64)
+    lens = np.array([3, 4], np.int64)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        e = fluid.layers.data(name="e", shape=[T, C], dtype="float32")
+        l = fluid.layers.data(name="l", shape=[T], dtype="int64")
+        ln = fluid.layers.data(name="ln", shape=[1], dtype="int64")
+        ll = fluid.layers.linear_chain_crf(
+            e, l, param_attr=fluid.ParamAttr(name="crf_w"), length=ln)
+        path = fluid.layers.crf_decoding(
+            e, param_attr=fluid.ParamAttr(name="crf_w"), length=ln)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set("crf_w", trans)
+        ll_v, path_v = exe.run(
+            main, feed={"e": em, "l": label, "ln": lens},
+            fetch_list=[ll, path])
+
+    start, end, tr = trans[0], trans[1], trans[2:]
+
+    def score(b, seq):
+        s = start[seq[0]] + em[b, 0, seq[0]]
+        for t in range(1, len(seq)):
+            s += tr[seq[t - 1], seq[t]] + em[b, t, seq[t]]
+        return s + end[seq[-1]]
+
+    for b in range(B):
+        n = int(lens[b])
+        all_scores = [score(b, seq)
+                      for seq in itertools.product(range(C), repeat=n)]
+        logz = np.log(np.sum(np.exp(all_scores)))
+        expect_ll = score(b, label[b, :n]) - logz
+        np.testing.assert_allclose(np.asarray(ll_v)[b, 0], expect_ll,
+                                   rtol=1e-4, atol=1e-5)
+        best = max(itertools.product(range(C), repeat=n),
+                   key=lambda s: score(b, s))
+        np.testing.assert_array_equal(np.asarray(path_v)[b, :n],
+                                      np.asarray(best))
+
+
+def test_crf_training_improves_likelihood():
+    B, T, C = 8, 6, 4
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, T, 8).astype(np.float32)
+    label = rng.randint(0, C, (B, T)).astype(np.int64)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[T, 8], dtype="float32")
+        lv = fluid.layers.data(name="l", shape=[T], dtype="int64")
+        em = fluid.layers.fc(input=xv, size=C, num_flatten_dims=2)
+        ll = fluid.layers.linear_chain_crf(
+            em, lv, param_attr=fluid.ParamAttr(name="crf_w2"))
+        loss = fluid.layers.mean(fluid.layers.scale(ll, scale=-1.0))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"x": x, "l": label}, fetch_list=[loss])[0]))
+            for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_nce_and_hsigmoid_train():
+    B, D, C = 16, 8, 32
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, D).astype(np.float32)
+    y = rng.randint(0, C, (B, 1)).astype(np.int64)
+
+    for which in ("nce", "hsigmoid"):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            xv = fluid.layers.data(name="x", shape=[D], dtype="float32")
+            yv = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(input=xv, size=D, act="tanh")
+            if which == "nce":
+                cost = fluid.layers.nce(h, yv, num_total_classes=C,
+                                        num_neg_samples=8)
+            else:
+                cost = fluid.layers.hsigmoid(h, yv, num_classes=C)
+            loss = fluid.layers.mean(cost)
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses = [float(np.asarray(exe.run(
+                main, feed={"x": x, "y": y}, fetch_list=[loss])[0]))
+                for _ in range(25)]
+        assert losses[-1] < losses[0], (which, losses[0], losses[-1])
+
+
+def test_misc_op_oracles():
+    rng = np.random.RandomState(0)
+    # multiplex
+    x1 = rng.randn(4, 3).astype(np.float32)
+    x2 = rng.randn(4, 3).astype(np.float32)
+    idx = np.array([[0], [1], [1], [0]], np.int64)
+
+    def build_mux():
+        a = fluid.layers.data(name="a", shape=[3], dtype="float32")
+        b = fluid.layers.data(name="b", shape=[3], dtype="float32")
+        i = fluid.layers.data(name="i", shape=[1], dtype="int64")
+        return [fluid.layers.multiplex([a, b], i)]
+
+    (mux,) = _run(build_mux, {"a": x1, "b": x2, "i": idx})
+    expect = np.where(idx == 0, x1, x2)
+    np.testing.assert_allclose(mux, expect)
+
+    # shuffle_channel + space_to_depth shape/permutation contracts
+    x = np.arange(1 * 4 * 2 * 2, dtype=np.float32).reshape(1, 4, 2, 2)
+
+    def build_sc():
+        xv = fluid.layers.data(name="x", shape=[4, 2, 2], dtype="float32")
+        return [fluid.layers.shuffle_channel(xv, group=2),
+                fluid.layers.space_to_depth(xv, blocksize=2)]
+
+    sc, s2d = _run(build_sc, {"x": x})
+    np.testing.assert_allclose(
+        sc, x.reshape(1, 2, 2, 2, 2).swapaxes(1, 2).reshape(x.shape))
+    assert s2d.shape == (1, 16, 1, 1)
+
+    # cos_sim
+    a = rng.randn(3, 5).astype(np.float32)
+    b = rng.randn(3, 5).astype(np.float32)
+
+    def build_cs():
+        av = fluid.layers.data(name="a", shape=[5], dtype="float32")
+        bv = fluid.layers.data(name="b", shape=[5], dtype="float32")
+        return [fluid.layers.cos_sim(av, bv)]
+
+    (cs,) = _run(build_cs, {"a": a, "b": b})
+    expect = (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                               * np.linalg.norm(b, axis=1))
+    np.testing.assert_allclose(cs.reshape(-1), expect, rtol=1e-5)
+
+
+def test_ctc_greedy_decoder_collapse():
+    # argmax path: [1, 1, 0, 2, 2, 0] -> collapse repeats, drop blanks ->
+    # [1, 2]
+    probs = np.zeros((1, 6, 3), np.float32)
+    for t, c in enumerate([1, 1, 0, 2, 2, 0]):
+        probs[0, t, c] = 1.0
+
+    def build():
+        p = fluid.layers.data(name="p", shape=[6, 3], dtype="float32")
+        out, ln = fluid.layers.ctc_greedy_decoder(p, blank=0)
+        return [out, ln]
+
+    out, ln = _run(build, {"p": probs})
+    assert int(ln[0]) == 2
+    np.testing.assert_array_equal(out[0, :2], [1, 2])
+    assert (out[0, 2:] == -1).all()
+
+
+def test_conv3d_pool3d_shapes_and_grad():
+    x = np.random.RandomState(0).randn(2, 3, 8, 8, 8).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[3, 8, 8, 8],
+                               dtype="float32")
+        c = fluid.layers.conv3d(xv, num_filters=4, filter_size=3,
+                                padding=1)
+        p = fluid.layers.pool3d(c, pool_size=2, pool_type="avg",
+                                pool_stride=2)
+        loss = fluid.layers.mean(p)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pv, = exe.run(main, feed={"x": x}, fetch_list=[p])
+    assert np.asarray(pv).shape == (2, 4, 4, 4, 4)
+
+
+def test_grid_sampler_identity():
+    """An identity affine grid samples the image back unchanged."""
+    x = np.random.RandomState(0).randn(1, 2, 4, 4).astype(np.float32)
+    theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[2, 4, 4], dtype="float32")
+        tv = fluid.layers.data(name="t", shape=[2, 3], dtype="float32")
+        grid = fluid.layers.affine_grid(tv, out_shape=[1, 2, 4, 4])
+        return [fluid.layers.grid_sampler(xv, grid)]
+
+    (out,) = _run(build, {"x": x, "t": theta})
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-5)
+
+
+def test_selu_and_losses_finite():
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    lab = np.random.RandomState(1).randint(0, 6, (4, 1)).astype(np.int64)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        lv = fluid.layers.data(name="l", shape=[1], dtype="int64")
+        s = fluid.layers.selu(xv)
+        bpr = fluid.layers.bpr_loss(fluid.layers.softmax(xv), lv)
+        return [s, bpr]
+
+    s, bpr = _run(build, {"x": x, "l": lab})
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    expect = scale * np.where(x > 0, x, alpha * np.expm1(x))
+    np.testing.assert_allclose(s, expect, rtol=1e-5)
+    assert np.isfinite(bpr).all() and (bpr > 0).all()
+
+
+def test_final_batch_layers():
+    rng = np.random.RandomState(0)
+    # psroi_pool: constant-feature invariance
+    oc, ph, pw = 2, 2, 2
+    x = np.full((1, oc * ph * pw, 8, 8), 1.5, np.float32)
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+
+    def build_ps():
+        xv = fluid.layers.data(name="x", shape=[oc * ph * pw, 8, 8],
+                               dtype="float32")
+        r = fluid.layers.data(name="r", shape=[4], dtype="float32")
+        return [fluid.layers.psroi_pool(xv, r, output_channels=oc,
+                                        spatial_scale=1.0,
+                                        pooled_height=ph, pooled_width=pw)]
+
+    (ps,) = _run(build_ps, {"x": x, "r": rois})
+    assert ps.shape == (1, oc, ph, pw)
+    np.testing.assert_allclose(ps, 1.5, rtol=1e-6)
+
+    # stacked lstm layer: shapes + finite training signal
+    B, T, D, H = 3, 5, 6, 8
+    xd = rng.randn(B, T, D).astype(np.float32)
+
+    def build_lstm():
+        xv = fluid.layers.data(name="x", shape=[T, D], dtype="float32")
+        out, lh, lc = fluid.layers.lstm(xv, None, None, T, H,
+                                        num_layers=2)
+        return [out, lh]
+
+    out, lh = _run(build_lstm, {"x": xd})
+    assert out.shape == (B, T, H) and lh.shape == (B, H)
+
+    # dynamic_lstmp: projected width
+    def build_lstmp():
+        xv = fluid.layers.data(name="x", shape=[T, 4 * H], dtype="float32")
+        proj, cell = fluid.layers.dynamic_lstmp(xv, size=4 * H,
+                                                proj_size=3)
+        return [proj]
+
+    (proj,) = _run(build_lstmp,
+                   {"x": rng.randn(B, T, 4 * H).astype(np.float32)})
+    assert proj.shape == (B, T, 3)
+
+    # tensor_array_to_tensor over a written array
+    def build_arr():
+        import paddle_tpu.fluid as f
+
+        x0 = f.layers.fill_constant(shape=[2, 3], dtype="float32",
+                                    value=1.0)
+        i0 = f.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = f.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        arr = f.layers.array_write(x0, i0)
+        f.layers.array_write(
+            f.layers.scale(x0, scale=2.0), i1, array=arr)
+        out, idx = f.layers.tensor_array_to_tensor(arr, axis=0)
+        return [out, idx]
+
+    out, idx = _run(build_arr, {})
+    assert int(idx[0]) == 2
+    np.testing.assert_allclose(out[:2], 1.0)
+    np.testing.assert_allclose(out[2:4], 2.0)
+
+
+def test_conv3d_transpose_shape_contract():
+    """(D-1)*s - 2p + d*(k-1) + 1, like conv2d_transpose."""
+    x = np.random.RandomState(0).randn(1, 2, 4, 4, 4).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[2, 4, 4, 4],
+                               dtype="float32")
+        return [fluid.layers.conv3d_transpose(xv, num_filters=3,
+                                              filter_size=3, stride=2)]
+
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (1, 3, 9, 9, 9), out.shape
+
+
+def test_flatten_dynamic_batch():
+    x = np.random.RandomState(0).randn(5, 3, 4).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[3, 4], dtype="float32")
+        return [fluid.layers.flatten(xv)]
+
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (5, 12)
