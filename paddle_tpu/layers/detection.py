@@ -23,6 +23,8 @@ __all__ = [
     "roi_pool",
     "detection_output",
     "ssd_loss",
+    "multi_box_head",
+    "yolov3_loss",
 ]
 
 
@@ -314,3 +316,111 @@ def _gather_encoded(enc, match_idx):
         inputs={"Encoded": [enc], "MatchIndices": [match_idx]},
         outputs={"Out": [out], "OutWeight": [wt]})
     return out, wt
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference: layers/detection.py:1354): per
+    feature map, generate priors and 3x3/1x1 conv loc+conf predictions,
+    reshape and concat across maps. Returns
+    (mbox_locs, mbox_confs, boxes, variances)."""
+    from paddle_tpu.layers import nn as nn_layers
+    from paddle_tpu.layers import tensor as tensor_layers
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio interpolation
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_maps - 2)) \
+            if n_maps > 2 else 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_maps - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_maps - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        ms_list = ms if isinstance(ms, (list, tuple)) else [ms]
+        mx = max_sizes[i] if max_sizes else None
+        mx_list = (mx if isinstance(mx, (list, tuple)) else [mx]) \
+            if mx is not None else None
+        ar = aspect_ratios[i]
+        ar_list = ar if isinstance(ar, (list, tuple)) else [ar]
+        st = steps[i] if steps else (
+            (step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0))
+        if not isinstance(st, (list, tuple)):
+            st = (st, st)  # canonical SSD configs give one scalar per map
+        box, var = prior_box(
+            feat, image, min_sizes=ms_list, max_sizes=mx_list,
+            aspect_ratios=ar_list, variance=variance, flip=flip,
+            clip=clip, steps=list(st), offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        from paddle_tpu.ops.detection_ops import _expand_aspect_ratios
+
+        num_priors = (len(ms_list) * len(_expand_aspect_ratios(
+            ar_list, flip)) + (len(mx_list) if mx_list else 0))
+        loc = nn_layers.conv2d(feat, num_filters=num_priors * 4,
+                               filter_size=kernel_size, padding=pad,
+                               stride=stride)
+        conf = nn_layers.conv2d(feat, num_filters=num_priors * num_classes,
+                                filter_size=kernel_size, padding=pad,
+                                stride=stride)
+        # NCHW -> [B, H*W*priors, 4 / num_classes]
+        loc = nn_layers.transpose(loc, perm=[0, 2, 3, 1])
+        loc = nn_layers.reshape(loc, shape=[-1 if loc.shape[0] in (None, -1)
+                                            else loc.shape[0],
+                                            _numel(loc.shape[1:]) // 4, 4])
+        conf = nn_layers.transpose(conf, perm=[0, 2, 3, 1])
+        conf = nn_layers.reshape(
+            conf, shape=[-1 if conf.shape[0] in (None, -1)
+                         else conf.shape[0],
+                         _numel(conf.shape[1:]) // num_classes,
+                         num_classes])
+        box = nn_layers.reshape(box, shape=[-1, 4])
+        var = nn_layers.reshape(var, shape=[-1, 4])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(box)
+        vars_all.append(var)
+
+    mbox_locs = tensor_layers.concat(locs, axis=1) if len(locs) > 1 else locs[0]
+    mbox_confs = tensor_layers.concat(confs, axis=1) \
+        if len(confs) > 1 else confs[0]
+    boxes = tensor_layers.concat(boxes_all, axis=0) \
+        if len(boxes_all) > 1 else boxes_all[0]
+    variances = tensor_layers.concat(vars_all, axis=0) \
+        if len(vars_all) > 1 else vars_all[0]
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, name=None):
+    """(reference: layers/detection.py:508)"""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = _out(helper)
+    obj_mask = _out(helper)
+    match_mask = _out(helper, "int32")
+    helper.append_op(
+        type="yolov3_loss",
+        inputs={"X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+        outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                 "GTMatchMask": [match_mask]},
+        attrs={"anchors": list(anchors),
+               "anchor_mask": list(anchor_mask),
+               "class_num": class_num,
+               "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio})
+    return loss

@@ -154,6 +154,7 @@ __all__ = [
     "dynamic_lstmp",
     "lstm",
     "psroi_pool",
+    "chunk_eval",
 ]
 
 
@@ -2242,3 +2243,28 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
                "pooled_height": pooled_height,
                "pooled_width": pooled_width})
     return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """(reference: layers/nn.py chunk_eval). Returns (precision, recall,
+    f1, num_infer_chunks, num_label_chunks, num_correct_chunks)."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    n_inf = helper.create_variable_for_type_inference("int64")
+    n_lab = helper.create_variable_for_type_inference("int64")
+    n_cor = helper.create_variable_for_type_inference("int64")
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval", inputs=inputs,
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1], "NumInferChunks": [n_inf],
+                 "NumLabelChunks": [n_lab], "NumCorrectChunks": [n_cor]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_inf, n_lab, n_cor

@@ -514,3 +514,128 @@ def gather_encoded(ctx, ins, attrs):
     gathered = enc[idx, m]                               # [M, 4]
     w = (match >= 0).astype(jnp.float32)[:, None]
     return {"Out": [jnp.where(w > 0, gathered, 0.0)], "OutWeight": [w]}
+
+
+@register_op("yolov3_loss", no_grad_inputs=("GTBox", "GTLabel"))
+def yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 loss (reference: detection/yolov3_loss_op.h, followed
+    term-for-term): per-cell best-IoU > ignore_thresh suppresses the
+    negative objectness term; each valid gt picks its best anchor by
+    shape IoU, and if that anchor is in anchor_mask the responsible cell
+    takes location (sigmoid-CE on x/y, L2 on w/h, scaled 2-w*h), class
+    (per-class sigmoid-CE) and positive objectness losses. Gradient via
+    autodiff of this lowering instead of the hand-written grad kernel."""
+    x = single(ins, "X")                       # [N, M*(5+C), H, W]
+    gtbox = single(ins, "GTBox").astype(jnp.float32)   # [N, B, 4] cx cy w h
+    gtlabel = single(ins, "GTLabel")
+    if gtlabel.ndim == 3 and gtlabel.shape[-1] == 1:
+        gtlabel = gtlabel[..., 0]
+    gtlabel = gtlabel.astype(jnp.int32)        # [N, B]
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs.get(
+        "anchor_mask", list(range(len(anchors) // 2)))]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+
+    N, _, H, W = x.shape
+    M = len(anchor_mask)
+    B = gtbox.shape[1]
+    input_size = downsample * H                # reference: square grids
+    xr = x.reshape(N, M, 5 + class_num, H, W).astype(jnp.float32)
+    px, py = xr[:, :, 0], xr[:, :, 1]
+    pw, ph = xr[:, :, 2], xr[:, :, 3]
+    pobj = xr[:, :, 4]
+    pcls = xr[:, :, 5:]                        # [N, M, C, H, W]
+
+    def sce(logit, label):
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    aw = jnp.asarray([anchors[2 * a] for a in anchor_mask], jnp.float32)
+    ah = jnp.asarray([anchors[2 * a + 1] for a in anchor_mask],
+                     jnp.float32)
+    gi_grid = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gj_grid = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (gi_grid + jax.nn.sigmoid(px)) / H    # reference uses grid_size=h
+    by = (gj_grid + jax.nn.sigmoid(py)) / H
+    bw = jnp.exp(pw) * aw[None, :, None, None] / input_size
+    bh = jnp.exp(ph) * ah[None, :, None, None] / input_size
+
+    valid = (gtbox[..., 2] > 1e-6) & (gtbox[..., 3] > 1e-6)  # [N, B]
+
+    def center_iou(ax, ay, aw_, ah_, bx_, by_, bw_, bh_):
+        iw = (jnp.minimum(ax + aw_ / 2, bx_ + bw_ / 2)
+              - jnp.maximum(ax - aw_ / 2, bx_ - bw_ / 2))
+        ih = (jnp.minimum(ay + ah_ / 2, by_ + bh_ / 2)
+              - jnp.maximum(ay - ah_ / 2, by_ - bh_ / 2))
+        inter = jnp.where((iw > 0) & (ih > 0), iw * ih, 0.0)
+        union = aw_ * ah_ + bw_ * bh_ - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    # per-prediction best IoU against valid gts -> ignore mask
+    g = gtbox[:, None, None, None, :, :]       # [N,1,1,1,B,4]
+    iou_all = center_iou(
+        bx[..., None], by[..., None], bw[..., None], bh[..., None],
+        g[..., 0], g[..., 1], g[..., 2], g[..., 3])  # [N,M,H,W,B]
+    iou_all = jnp.where(valid[:, None, None, None, :], iou_all, 0.0)
+    best_iou = jnp.max(iou_all, axis=-1)       # [N, M, H, W]
+    ignored = best_iou > ignore_thresh
+
+    # per-gt best anchor by shape IoU over ALL anchors
+    an_w = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    an_h = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    shape_iou = center_iou(
+        0.0, 0.0, an_w[None, None, :], an_h[None, None, :],
+        0.0, 0.0, gtbox[..., 2:3], gtbox[..., 3:4])  # [N, B, an_num]
+    best_n = jnp.argmax(shape_iou, axis=-1).astype(jnp.int32)  # [N, B]
+    mask_lookup = jnp.full((len(anchors) // 2,), -1, jnp.int32)
+    for mi, a in enumerate(anchor_mask):
+        mask_lookup = mask_lookup.at[a].set(mi)
+    mask_idx = mask_lookup[best_n]             # [N, B], -1 if unmasked
+    matched = valid & (mask_idx >= 0)
+
+    gi = jnp.clip((gtbox[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gtbox[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    mi_safe = jnp.maximum(mask_idx, 0)
+    n_idx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+
+    def gat(t):                                # t: [N, M, H, W] -> [N, B]
+        return t[n_idx, mi_safe, gj, gi]
+
+    tx = gtbox[..., 0] * W - gi
+    ty = gtbox[..., 1] * H - gj
+    aw_g = jnp.asarray(anchors[0::2], jnp.float32)[best_n]
+    ah_g = jnp.asarray(anchors[1::2], jnp.float32)[best_n]
+    tw = jnp.log(jnp.maximum(gtbox[..., 2] * input_size, 1e-9) / aw_g)
+    th = jnp.log(jnp.maximum(gtbox[..., 3] * input_size, 1e-9) / ah_g)
+    scale = 2.0 - gtbox[..., 2] * gtbox[..., 3]
+    loc = (sce(gat(px), tx) + sce(gat(py), ty)
+           + 0.5 * (gat(pw) - tw) ** 2 + 0.5 * (gat(ph) - th) ** 2)
+    loc_loss = jnp.sum(jnp.where(matched, loc * scale, 0.0), axis=1)
+
+    onehot = jax.nn.one_hot(gtlabel, class_num)         # [N, B, C]
+    cls_logits = pcls[n_idx[..., None], mi_safe[..., None],
+                      jnp.arange(class_num)[None, None, :],
+                      gj[..., None], gi[..., None]]     # [N, B, C]
+    cls = jnp.sum(sce(cls_logits, onehot), axis=-1)
+    cls_loss = jnp.sum(jnp.where(matched, cls, 0.0), axis=1)
+
+    # objectness mask: 0 negative, -1 ignored, 1 positive. Scatter-MAX so
+    # an unmatched/padding gt row (whose clamped indices collide with a
+    # real cell) contributes -1 and can never clobber a positive.
+    obj_mask = jnp.where(ignored, -1.0, 0.0)
+    flat = obj_mask.reshape(N, -1)
+    pos_flat = (mi_safe * H + gj) * W + gi
+    flat = flat.at[n_idx, pos_flat].max(
+        jnp.where(matched, 1.0, -1.0), mode="drop")
+    obj_mask = flat.reshape(N, M, H, W)
+    obj_loss = jnp.sum(
+        jnp.where(obj_mask > 0.5, sce(pobj, 1.0),
+                  jnp.where(obj_mask > -0.5, sce(pobj, 0.0), 0.0)),
+        axis=(1, 2, 3))
+
+    loss = loc_loss + cls_loss + obj_loss
+    return {"Loss": [loss.astype(x.dtype)],
+            "ObjectnessMask": [obj_mask],
+            "GTMatchMask": [jnp.where(valid, mask_idx, -1)]}

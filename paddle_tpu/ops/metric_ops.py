@@ -119,3 +119,98 @@ def precision_recall(ctx, ins, attrs):
     return {"BatchMetrics": [metrics(batch_states)],
             "AccumMetrics": [metrics(accum_states)],
             "AccumStatesInfo": [accum_states]}
+
+
+@register_no_grad_op("chunk_eval")
+def chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 for IO/IOB/IOE/IOBES tagging
+    (reference: operators/metrics/chunk_eval_op.cc; label encoding
+    tag_type = label % num_tag_types, chunk_type = label // num_tag_types,
+    labels >= num_chunk_types * num_tag_types are outside). A chunk is the
+    (begin, end, type) triple; correct = exact triple match, the conlleval
+    counting rule."""
+    import jax
+
+    inf = single(ins, "Inference")
+    lab = single(ins, "Label")
+    if inf.ndim == 3 and inf.shape[-1] == 1:
+        inf = inf[..., 0]
+    if lab.ndim == 3 and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    inf = inf.astype(jnp.int32)
+    lab = lab.astype(jnp.int32)
+    B, T = lab.shape
+    scheme = attrs.get("chunk_scheme", "IOB")
+    num_chunk_types = int(attrs["num_chunk_types"])
+    excluded = set(attrs.get("excluded_chunk_types", []) or [])
+    num_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    out_start = num_chunk_types * num_tag
+    lens = ins.get("SeqLength", [None])
+    lens = (lens[0].reshape(-1).astype(jnp.int32)
+            if lens and lens[0] is not None
+            else jnp.full((B,), T, jnp.int32))
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+
+    def marks(x):
+        inside = (x < out_start) & valid
+        ctype = x // num_tag
+        tag = x % num_tag
+        prev_in = jnp.concatenate(
+            [jnp.zeros((B, 1), bool), inside[:, :-1]], 1)
+        prev_ct = jnp.concatenate(
+            [jnp.full((B, 1), -1, jnp.int32), ctype[:, :-1]], 1)
+        prev_tag = jnp.concatenate(
+            [jnp.full((B, 1), -1, jnp.int32), tag[:, :-1]], 1)
+        next_in = jnp.concatenate(
+            [inside[:, 1:], jnp.zeros((B, 1), bool)], 1)
+        next_ct = jnp.concatenate(
+            [ctype[:, 1:], jnp.full((B, 1), -1, jnp.int32)], 1)
+        next_tag = jnp.concatenate(
+            [tag[:, 1:], jnp.full((B, 1), -1, jnp.int32)], 1)
+        cont = prev_in & (prev_ct == ctype)   # same-type run continues
+        cont_n = next_in & (next_ct == ctype)
+        if scheme == "plain":
+            # reference chunk_eval_op.cc: plain = tag_single, every
+            # in-chunk token is its own single-token chunk
+            start = inside
+            end = inside
+        elif scheme == "IOB":                 # B=0, I=1
+            start = inside & ((tag == 0) | ~cont)
+            end = inside & (~cont_n | (next_tag == 0))
+        elif scheme == "IOE":                 # I=0, E=1
+            start = inside & (~cont | (prev_tag == 1))
+            end = inside & ((tag == 1) | ~cont_n)
+        else:                                 # IOBES: B=0 I=1 E=2 S=3
+            start = inside & ((tag == 0) | (tag == 3) | ~cont)
+            end = inside & ((tag == 2) | (tag == 3) | ~cont_n
+                            | (next_tag == 0) | (next_tag == 3))
+        if excluded:
+            keep = jnp.ones_like(inside)
+            for e in excluded:
+                keep = keep & (ctype != e)
+            start, end = start & keep, end & keep
+        return start, end, ctype
+
+    s_inf, e_inf, ct_inf = marks(inf)
+    s_lab, e_lab, ct_lab = marks(lab)
+
+    def end_index(end):
+        idx = jnp.where(end, jnp.arange(T)[None, :], T)
+        return jnp.flip(
+            jax.lax.cummin(jnp.flip(idx, 1), axis=1), 1)
+
+    match = (s_inf & s_lab & (ct_inf == ct_lab)
+             & (end_index(e_inf) == end_index(e_lab)))
+    n_inf = jnp.sum(s_inf).astype(jnp.int64)
+    n_lab = jnp.sum(s_lab).astype(jnp.int64)
+    n_cor = jnp.sum(match).astype(jnp.int64)
+    p = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0)
+    r = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0)
+    f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    one = lambda v: jnp.asarray(v).reshape(1)
+    return {"Precision": [one(p.astype(jnp.float32))],
+            "Recall": [one(r.astype(jnp.float32))],
+            "F1-Score": [one(f1.astype(jnp.float32))],
+            "NumInferChunks": [one(n_inf)],
+            "NumLabelChunks": [one(n_lab)],
+            "NumCorrectChunks": [one(n_cor)]}

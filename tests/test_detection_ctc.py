@@ -330,3 +330,92 @@ def test_ssd_loss_trains():
                   for _ in range(25)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_yolov3_loss_oracle():
+    """Follow the reference kernel loop (yolov3_loss_op.h) in numpy on a
+    tiny grid and compare."""
+    N, H, W, C = 1, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    anchor_mask = [0, 1, 2]
+    M = len(anchor_mask)
+    downsample = 8
+    input_size = downsample * H
+    ignore_thresh = 0.7
+    rng = np.random.RandomState(0)
+    x = (rng.randn(N, M * (5 + C), H, W) * 0.5).astype(np.float32)
+    gtbox = np.array([[[0.3, 0.4, 0.2, 0.3],
+                       [0.7, 0.6, 0.4, 0.2],
+                       [0.0, 0.0, 0.0, 0.0]]], np.float32)  # last invalid
+    gtlabel = np.array([[1, 2, 0]], np.int64)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[M * (5 + C), H, W],
+                               dtype="float32")
+        g = fluid.layers.data(name="g", shape=[3, 4], dtype="float32")
+        l = fluid.layers.data(name="l", shape=[3], dtype="int64")
+        return [fluid.layers.yolov3_loss(
+            xv, g, l, anchors=anchors, anchor_mask=anchor_mask,
+            class_num=C, ignore_thresh=ignore_thresh,
+            downsample_ratio=downsample)]
+
+    (loss_v,) = _run(build, {"x": x, "g": gtbox, "l": gtlabel})
+
+    # numpy oracle mirroring the reference loops
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def sce(v, t):
+        return max(v, 0.0) - v * t + np.log1p(np.exp(-abs(v)))
+
+    def iou_c(b1, b2):
+        iw = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) - max(
+            b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        ih = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) - max(
+            b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        inter = iw * ih if iw > 0 and ih > 0 else 0.0
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+    xr = x.reshape(N, M, 5 + C, H, W)
+    expect = 0.0
+    obj_target = np.zeros((M, H, W))           # 0 neg, -1 ign, 1 pos
+    for j in range(M):
+        for k in range(H):
+            for li in range(W):
+                pred = ((li + sig(xr[0, j, 0, k, li])) / H,
+                        (k + sig(xr[0, j, 1, k, li])) / H,
+                        np.exp(xr[0, j, 2, k, li]) * anchors[2 * j]
+                        / input_size,
+                        np.exp(xr[0, j, 3, k, li]) * anchors[2 * j + 1]
+                        / input_size)
+                best = max(iou_c(pred, gtbox[0, t]) for t in range(2))
+                if best > ignore_thresh:
+                    obj_target[j, k, li] = -1
+    for t in range(2):
+        g = gtbox[0, t]
+        gi, gj = int(g[0] * W), int(g[1] * H)
+        ious = [iou_c((0, 0, anchors[2 * a] / input_size,
+                       anchors[2 * a + 1] / input_size),
+                      (0, 0, g[2], g[3]))
+                for a in range(len(anchors) // 2)]
+        best_n = int(np.argmax(ious))
+        tx, ty = g[0] * W - gi, g[1] * H - gj
+        tw = np.log(g[2] * input_size / anchors[2 * best_n])
+        th = np.log(g[3] * input_size / anchors[2 * best_n + 1])
+        s = 2.0 - g[2] * g[3]
+        expect += s * (sce(xr[0, best_n, 0, gj, gi], tx)
+                       + sce(xr[0, best_n, 1, gj, gi], ty)
+                       + 0.5 * (xr[0, best_n, 2, gj, gi] - tw) ** 2
+                       + 0.5 * (xr[0, best_n, 3, gj, gi] - th) ** 2)
+        obj_target[best_n, gj, gi] = 1
+        for c in range(C):
+            expect += sce(xr[0, best_n, 5 + c, gj, gi],
+                          1.0 if c == gtlabel[0, t] else 0.0)
+    for j in range(M):
+        for k in range(H):
+            for li in range(W):
+                if obj_target[j, k, li] > 0.5:
+                    expect += sce(xr[0, j, 4, k, li], 1.0)
+                elif obj_target[j, k, li] > -0.5:
+                    expect += sce(xr[0, j, 4, k, li], 0.0)
+    np.testing.assert_allclose(np.asarray(loss_v)[0], expect, rtol=1e-4)

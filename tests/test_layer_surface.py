@@ -329,3 +329,53 @@ def test_flatten_dynamic_batch():
 
     (out,) = _run(build, {"x": x})
     assert out.shape == (5, 12)
+
+
+def test_chunk_eval_conll_example():
+    """IOB NER with 2 chunk types: B-A=0 I-A=1 B-B=2 I-B=3 O=4."""
+    # label:  B-A I-A O  B-B I-B O
+    # infer:  B-A I-A O  B-B B-B O   (second chunk split -> 1 correct of 2/3)
+    lab = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+    inf = np.array([[0, 1, 4, 2, 2, 4]], np.int64)
+
+    def build():
+        iv = fluid.layers.data(name="i", shape=[6], dtype="int64")
+        lv = fluid.layers.data(name="l", shape=[6], dtype="int64")
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            iv, lv, chunk_scheme="IOB", num_chunk_types=2)
+        return [p, r, f1, ni, nl, nc]
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": inf, "l": lab})
+    assert int(nl[0]) == 2 and int(ni[0]) == 3 and int(nc[0]) == 1
+    np.testing.assert_allclose(p[0], 1 / 3, rtol=1e-5)
+    np.testing.assert_allclose(r[0], 1 / 2, rtol=1e-5)
+
+
+def test_multi_box_head_shapes():
+    rng = np.random.RandomState(0)
+    f1v = rng.randn(2, 8, 8, 8).astype(np.float32)
+    f2v = rng.randn(2, 8, 4, 4).astype(np.float32)
+    img = np.zeros((2, 3, 64, 64), np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[8, 8, 8], dtype="float32")
+        b = fluid.layers.data(name="b", shape=[8, 4, 4], dtype="float32")
+        im = fluid.layers.data(name="im", shape=[3, 64, 64],
+                               dtype="float32")
+        locs, confs, boxes, vars_ = fluid.layers.multi_box_head(
+            inputs=[a, b], image=im, base_size=64, num_classes=4,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            flip=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        lv, cv, bv, vv = exe.run(
+            main, feed={"a": f1v, "b": f2v, "im": img},
+            fetch_list=[locs, confs, boxes, vars_])
+    lv, cv, bv, vv = map(np.asarray, (lv, cv, bv, vv))
+    n_priors = bv.shape[0]
+    assert lv.shape == (2, n_priors, 4)
+    assert cv.shape == (2, n_priors, 4)
+    assert vv.shape == bv.shape
