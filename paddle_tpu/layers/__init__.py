@@ -18,6 +18,12 @@ from paddle_tpu.layers.io import (  # noqa: F401
     py_reader,
     double_buffer,
     PyReader,
+    batch,
+    shuffle,
+    open_files,
+    read_file,
+    create_py_reader_by_data,
+    random_data_generator,
 )
 from paddle_tpu.layers.loss import *  # noqa: F401,F403
 from paddle_tpu.layers import detection  # noqa: F401

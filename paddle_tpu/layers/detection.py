@@ -25,6 +25,7 @@ __all__ = [
     "ssd_loss",
     "multi_box_head",
     "yolov3_loss",
+    "detection_map",
 ]
 
 
@@ -424,3 +425,102 @@ def yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask, class_num,
                "ignore_thresh": ignore_thresh,
                "downsample_ratio": downsample_ratio})
     return loss
+
+
+def _np_map(dets, gts, overlap_threshold, ap_version,
+            background_label=0, evaluate_difficult=True):
+    """Host-side mAP (the computation of the reference's detection_map
+    op, operators/detection/detection_map_op.h): greedy IoU matching per
+    class, AP by 'integral' or '11point', background class excluded.
+    dets: [B, K, 6] rows (label, score, x1, y1, x2, y2) padded label<0;
+    gts: [B, G, 5] rows (label, x1, y1, x2, y2) — or [B, G, 6] with a
+    trailing is_difficult flag honored when evaluate_difficult=False
+    (difficult gts neither count as positives nor penalize matches)."""
+    import numpy as np
+
+    def iou(a, b):
+        ix = min(a[2], b[2]) - max(a[0], b[0])
+        iy = min(a[3], b[3]) - max(a[1], b[1])
+        if ix <= 0 or iy <= 0:
+            return 0.0
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / max(ua, 1e-10)
+
+    has_difficult = gts.shape[-1] >= 6
+    classes = sorted({int(g[0]) for img in gts for g in img
+                      if g[0] >= 0 and int(g[0]) != background_label})
+    aps = []
+    for c in classes:
+        records = []   # (score, is_tp)
+        n_gt = 0
+        for b in range(len(gts)):
+            rows = [g for g in gts[b] if int(g[0]) == c]
+            gt_c = [g[1:5] for g in rows]
+            diff = [bool(g[5]) if has_difficult else False for g in rows]
+            n_gt += sum(1 for d_ in diff if evaluate_difficult or not d_)
+            used = [False] * len(gt_c)
+            det_c = sorted([d for d in dets[b] if int(d[0]) == c],
+                           key=lambda d: -d[1])
+            for d in det_c:
+                best, best_i = 0.0, -1
+                for i, g in enumerate(gt_c):
+                    o = iou(d[2:], g)
+                    if o > best:
+                        best, best_i = o, i
+                if (best > overlap_threshold and best_i >= 0
+                        and not evaluate_difficult and diff[best_i]):
+                    continue  # difficult match: neither TP nor FP
+                tp = best > overlap_threshold and not used[best_i]
+                if tp:
+                    used[best_i] = True
+                records.append((float(d[1]), tp))
+        if n_gt == 0:
+            continue
+        records.sort(key=lambda r: -r[0])
+        tps = np.cumsum([1.0 if r[1] else 0.0 for r in records]) \
+            if records else np.zeros(0)
+        fps = np.cumsum([0.0 if r[1] else 1.0 for r in records]) \
+            if records else np.zeros(0)
+        recall = tps / n_gt if len(tps) else np.zeros(0)
+        precision = tps / np.maximum(tps + fps, 1e-10) \
+            if len(tps) else np.zeros(0)
+        if ap_version == "11point":
+            ap = 0.0
+            for t in np.arange(0.0, 1.01, 0.1):
+                p = precision[recall >= t].max() \
+                    if np.any(recall >= t) else 0.0
+                ap += p / 11.0
+        else:  # integral
+            ap, prev_r = 0.0, 0.0
+            for p, r in zip(precision, recall):
+                ap += p * (r - prev_r)
+                prev_r = r
+        aps.append(ap)
+    return np.float32(np.mean(aps) if aps else 0.0)
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  ap_version="integral"):
+    """mAP metric (reference: layers/detection.py:610 → detection_map
+    op). Runs host-side through py_func on the static-shape detection
+    format; returns a [1] float map value."""
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.layers import nn as nn_layers
+
+    helper = LayerHelper("detection_map")
+    out = helper.create_variable_for_type_inference("float32")
+    out.desc.shape = [1]
+
+    def compute(dets, gts):
+        import numpy as np
+
+        return _np_map(np.asarray(dets), np.asarray(gts),
+                       overlap_threshold, ap_version,
+                       background_label=background_label,
+                       evaluate_difficult=evaluate_difficult).reshape(1)
+
+    nn_layers.py_func(compute, [detect_res, label], [out])
+    return out

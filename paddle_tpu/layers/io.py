@@ -117,3 +117,92 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
 def double_buffer(reader, place=None, name=None):
     """Kept for API parity — prefetch is inherent to PyReader's queue."""
     return reader
+
+
+def batch(reader, batch_size):
+    """(reference: layers/io.py batch — the old C++ reader-op form).
+    TPU-native redesign: file readers are python readers (see
+    paddle_tpu.reader); this is the same batching decorator under the
+    reference's layer name."""
+    from paddle_tpu.reader.decorator import batch as _batch
+
+    return _batch(reader, batch_size)
+
+
+def shuffle(reader, buffer_size):
+    """(reference: layers/io.py shuffle) — python-reader decorator form."""
+    from paddle_tpu.reader.decorator import shuffle as _shuffle
+
+    return _shuffle(reader, buffer_size)
+
+
+def open_files(filenames, shapes=None, lod_levels=None, dtypes=None,
+               thread_num=None, buffer_size=None, pass_num=1,
+               is_test=None):
+    """(reference: layers/io.py open_files — RecordIO file reader ops).
+    TPU-native redesign: returns a python reader over the RecordIO files;
+    pair with fluid.layers.batch / PyReader for feeding. Each record is
+    yielded as raw bytes unless shapes/dtypes are given, in which case
+    records are parsed as flat arrays of the declared dtype/shape tuple."""
+    import numpy as np
+
+    from paddle_tpu import recordio
+
+    if isinstance(filenames, str):
+        filenames = [filenames]
+
+    def reader():
+        for _ in range(pass_num):
+            for fname in filenames:
+                for rec in recordio.Reader(fname):
+                    if not dtypes:
+                        yield rec
+                        continue
+                    out, off = [], 0
+                    for shape, dtype in zip(shapes, dtypes):
+                        n = int(np.prod(shape))
+                        arr = np.frombuffer(
+                            rec, dtype=dtype, count=n,
+                            offset=off).reshape(shape)
+                        off += arr.nbytes
+                        out.append(arr)
+                    yield tuple(out)
+
+    return reader
+
+
+def read_file(reader):
+    """(reference: layers/io.py read_file). With python readers there is
+    no in-graph file op; feed via DataFeeder or PyReader instead."""
+    raise NotImplementedError(
+        "read_file consumed the C++ reader ops; use the returned python "
+        "reader with fluid.DataFeeder or fluid.layers.py_reader "
+        "(see open_files docstring)")
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """(reference: layers/io.py create_py_reader_by_data) — a PyReader
+    built from existing data vars instead of shapes/dtypes."""
+    from paddle_tpu.core.types import convert_dtype_to_np
+
+    shapes = [list(v.shape) for v in feed_list]
+    dtypes = [str(convert_dtype_to_np(v.dtype)) for v in feed_list]
+    return py_reader(capacity=capacity, shapes=shapes, dtypes=dtypes,
+                     name=name, use_double_buffer=use_double_buffer)
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=True):
+    """(reference: layers/io.py random_data_generator) — python reader of
+    uniform random tuples."""
+    import numpy as np
+
+    def reader():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(
+                rng.uniform(low, high, s).astype(np.float32)
+                for s in shapes)
+
+    return reader

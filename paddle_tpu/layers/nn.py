@@ -155,6 +155,9 @@ __all__ = [
     "lstm",
     "psroi_pool",
     "chunk_eval",
+    "py_func",
+    "load",
+    "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -2268,3 +2271,73 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                "num_chunk_types": num_chunk_types,
                "excluded_chunk_types": excluded_chunk_types or []})
     return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a python callable inside the graph (reference: layers/nn.py
+    py_func → py_func_op.cc, here via jax.pure_callback — see
+    ops/misc_ops.py). ``out`` vars need static shapes; with
+    ``backward_func(x..., dout...) -> dx...`` the op is differentiable.
+
+    CONVENTION DIVERGENCE from the reference: backward_func receives the
+    forward INPUTS followed by the output grads (NOT the forward outputs
+    — recompute them inside if needed), and skip_vars_in_backward_input
+    is not supported."""
+    from paddle_tpu.ops.misc_ops import register_py_func
+
+    if skip_vars_in_backward_input is not None:
+        raise NotImplementedError(
+            "py_func: skip_vars_in_backward_input is not supported — "
+            "backward_func receives (inputs..., out_grads...) here")
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    from paddle_tpu.core.types import convert_dtype_to_np
+
+    attrs = {
+        "func_id": register_py_func(func),
+        "out_shapes": [list(o.shape) for o in outs],
+        "out_dtypes": [str(convert_dtype_to_np(o.dtype)) for o in outs],
+    }
+    if backward_func is not None:
+        attrs["backward_func_id"] = register_py_func(backward_func)
+    helper.append_op(type="py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)}, attrs=attrs)
+    if backward_func is None:
+        for o in outs:
+            o.stop_gradient = True
+    return out
+
+
+def load(out, file_path, load_as_fp16=None):
+    """(reference: layers/io.py load → load_op loading a saved var file
+    at run time). Here the file is read eagerly at build time (reference
+    tensor-stream or .npy) and assigned as the var's init value via an
+    assign op on first run."""
+    import numpy as np
+
+    from paddle_tpu import compat
+
+    try:
+        arr = compat.load_reference_var(file_path)
+    except Exception:
+        arr = np.load(file_path)
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    helper = LayerHelper("load")
+    helper.append_op(
+        type="assign_value", inputs={},
+        outputs={"Out": [out]},
+        attrs={"shape": list(arr.shape),
+               "values": arr.reshape(-1).tolist(),
+               "dtype": str(arr.dtype)})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """(reference: layers/control_flow.py reorder_lod_tensor_by_rank).
+    The padded+length representation never reorders rows by length —
+    masked scans make reordering unnecessary (see DynamicRNN) — so this
+    is the identity."""
+    return x

@@ -181,7 +181,10 @@ def chunk_eval(ctx, ins, attrs):
             start = inside & (~cont | (prev_tag == 1))
             end = inside & ((tag == 1) | ~cont_n)
         else:                                 # IOBES: B=0 I=1 E=2 S=3
-            start = inside & ((tag == 0) | (tag == 3) | ~cont)
+            # an I/E after a same-type E or S also begins a new chunk
+            # (reference ChunkBegin: prev tag end/single -> begin)
+            start = inside & ((tag == 0) | (tag == 3) | ~cont
+                              | (prev_tag == 2) | (prev_tag == 3))
             end = inside & ((tag == 2) | (tag == 3) | ~cont_n
                             | (next_tag == 0) | (next_tag == 3))
         if excluded:

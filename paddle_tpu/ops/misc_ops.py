@@ -783,3 +783,73 @@ def psroi_pool(ctx, ins, attrs):
 
     out = jax.vmap(one_roi)(rois, bidx.astype(jnp.int32))
     return {"Out": [out]}
+
+
+# -- py_func: arbitrary python in the graph via host callback ---------------
+#
+# The reference registers python callables in a pybind registry and calls
+# them from a CPU kernel (reference: operators/py_func_op.cc +
+# layers/nn.py py_func). Here the callable runs through jax.pure_callback:
+# the graph stays jittable, XLA inserts a host transfer around the call.
+# Output shapes must be static (callback contract).
+
+_PY_FUNC_REGISTRY = {}
+
+
+def register_py_func(fn):
+    fid = len(_PY_FUNC_REGISTRY)
+    _PY_FUNC_REGISTRY[fid] = fn
+    return fid
+
+
+@register_op("py_func")
+def py_func_op(ctx, ins, attrs):
+    import numpy as np
+
+    fn = _PY_FUNC_REGISTRY[int(attrs["func_id"])]
+    xs = ins.get("X", [])
+    out_shapes = attrs["out_shapes"]
+    out_dtypes = attrs["out_dtypes"]
+    result_shapes = [
+        jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+        for s, d in zip(out_shapes, out_dtypes)
+    ]
+
+    def host_fn(*arrays):
+        out = fn(*arrays)
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o, dtype=np.dtype(d))
+                for o, d in zip(out, out_dtypes)]
+
+    outs = jax.pure_callback(host_fn, result_shapes, *xs)
+    return {"Out": list(outs)}
+
+
+@register_no_grad_op("py_func_grad")
+def py_func_grad(ctx, ins, attrs):
+    import numpy as np
+
+    fn = _PY_FUNC_REGISTRY[int(attrs["backward_func_id"])]
+    xs = ins.get("X", [])
+    # Out@GRAD is position-aligned with the forward outputs; absent slots
+    # (outputs that do not feed the loss) arrive as None and become zero
+    # cotangents so backward_func's argument list never shifts.
+    ogs = [
+        g if g is not None else jnp.zeros(tuple(s), np.dtype(d))
+        for g, s, d in zip(ins.get("Out@GRAD", []),
+                           attrs["out_shapes"], attrs["out_dtypes"])
+    ]
+    in_shapes = [(tuple(x.shape), str(x.dtype)) for x in xs]
+    result_shapes = [
+        jax.ShapeDtypeStruct(s, np.dtype(d)) for s, d in in_shapes
+    ]
+
+    def host_fn(*arrays):
+        n = len(xs)
+        out = fn(*arrays[:n], *arrays[n:])
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o, dtype=np.dtype(d))
+                for o, (_, d) in zip(out, in_shapes)]
+
+    grads = jax.pure_callback(host_fn, result_shapes, *xs, *ogs)
+    return {"X@GRAD": list(grads)}

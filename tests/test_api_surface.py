@@ -13,12 +13,9 @@ import paddle_tpu.fluid as fluid
 # Documented gaps (COVERAGE.md "Remaining known gaps") — everything else
 # in the reference's layers __all__ must resolve.
 KNOWN_GAPS = {
-    "Preprocessor", "batch", "create_py_reader_by_data",
-    "detection_map", "generate_mask_labels", "generate_proposal_labels",
-    "generate_proposals", "load", "open_files",
-    "py_func", "random_data_generator", "read_file",
-    "reorder_lod_tensor_by_rank", "roi_perspective_transform",
-    "rpn_target_assign", "shuffle", "similarity_focus", "tree_conv",
+    "Preprocessor", "generate_mask_labels", "generate_proposal_labels",
+    "generate_proposals", "roi_perspective_transform",
+    "rpn_target_assign", "similarity_focus", "tree_conv",
 }
 
 REFERENCE_LAYER_FILES = ["nn.py", "tensor.py", "control_flow.py",

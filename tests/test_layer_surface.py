@@ -379,3 +379,124 @@ def test_multi_box_head_shapes():
     assert lv.shape == (2, n_priors, 4)
     assert cv.shape == (2, n_priors, 4)
     assert vv.shape == bv.shape
+
+
+def test_py_func_forward_and_backward():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+
+    def fwd(a):
+        return a * a
+
+    def bwd(a, dout):
+        return 2.0 * a * dout
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                               stop_gradient=False)
+        block = main.global_block()
+        o = block.create_var(name="pyf_out", shape=[2, 2],
+                             dtype="float32")
+        fluid.layers.py_func(fwd, xv, o, backward_func=bwd)
+        loss = fluid.layers.mean(o)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ov, gx = exe.run(main, feed={"x": x},
+                         fetch_list=["pyf_out", "x@GRAD"])
+    np.testing.assert_allclose(np.asarray(ov), x * x, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx), 2 * x / 4.0, rtol=1e-6)
+
+
+def test_detection_map_metric():
+    # image 0: one gt of class 1, matched by a high-score det -> AP 1.0
+    # image 0 also has a class-2 gt missed entirely -> AP 0.0; mAP 0.5
+    dets = np.array([[[1, 0.9, 0, 0, 10, 10],
+                      [-1, 0, 0, 0, 0, 0]]], np.float32)
+    gts = np.array([[[1, 0, 0, 10, 10],
+                     [2, 20, 20, 30, 30]]], np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        d = fluid.layers.data(name="d", shape=[2, 6], dtype="float32")
+        g = fluid.layers.data(name="g", shape=[2, 5], dtype="float32")
+        m = fluid.layers.detection_map(d, g, class_num=3,
+                                       overlap_threshold=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (mv,) = exe.run(main, feed={"d": dets, "g": gts},
+                        fetch_list=[m])
+    np.testing.assert_allclose(np.asarray(mv)[0], 0.5, rtol=1e-5)
+
+
+def test_open_files_batch_shuffle_readers(tmp_path):
+    from paddle_tpu import recordio
+
+    path = str(tmp_path / "data.recordio")
+    w = recordio.Writer(path)
+    for i in range(10):
+        rec = np.full((3,), i, np.float32)
+        w.write(rec.tobytes())
+    w.close()
+    reader = fluid.layers.open_files(
+        [path], shapes=[[3]], dtypes=["float32"])
+    batched = fluid.layers.batch(
+        fluid.layers.shuffle(reader, buffer_size=10), batch_size=5)
+    batches = list(batched())
+    assert len(batches) == 2 and len(batches[0]) == 5
+    vals = sorted(float(item[0][0]) for b in batches for item in b)
+    assert vals == [float(i) for i in range(10)]
+
+
+def test_py_func_partial_output_grads():
+    """Only one of two py_func outputs feeds the loss: the absent grad
+    must arrive as zeros in the right argument slot."""
+    x = np.array([[1.0, 2.0]], np.float32)
+    seen = {}
+
+    def fwd(a):
+        return a * 2.0, a * 3.0
+
+    def bwd(a, d1, d2):
+        seen["d1"] = np.asarray(d1).copy()
+        seen["d2"] = np.asarray(d2).copy()
+        return 2.0 * d1 + 3.0 * d2
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                               stop_gradient=False)
+        block = main.global_block()
+        o1 = block.create_var(name="pp_o1", shape=[1, 2], dtype="float32")
+        o2 = block.create_var(name="pp_o2", shape=[1, 2], dtype="float32")
+        fluid.layers.py_func(fwd, xv, [o1, o2], backward_func=bwd)
+        loss = fluid.layers.mean(o2)  # o1 unused
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (gx,) = exe.run(main, feed={"x": x}, fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(seen["d1"], 0.0)
+    np.testing.assert_allclose(seen["d2"], 0.5)
+    np.testing.assert_allclose(np.asarray(gx), 3.0 * 0.5, rtol=1e-6)
+
+
+def test_chunk_eval_iobes_adjacent_chunks():
+    """S-A then E-A (tags 3, 2 of the same type) are TWO chunks."""
+    # IOBES, 1 chunk type: B=0 I=1 E=2 S=3, O=4
+    lab = np.array([[3, 2]], np.int64)
+
+    def build():
+        iv = fluid.layers.data(name="i", shape=[2], dtype="int64")
+        lv = fluid.layers.data(name="l", shape=[2], dtype="int64")
+        outs = fluid.layers.chunk_eval(iv, lv, chunk_scheme="IOBES",
+                                       num_chunk_types=1)
+        return [outs[4]]  # NumLabelChunks
+
+    (nl,) = _run(build, {"i": lab, "l": lab})
+    assert int(nl[0]) == 2, int(nl[0])
