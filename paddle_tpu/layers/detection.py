@@ -26,6 +26,8 @@ __all__ = [
     "multi_box_head",
     "yolov3_loss",
     "detection_map",
+    "generate_proposals",
+    "rpn_target_assign",
 ]
 
 
@@ -524,3 +526,65 @@ def detection_map(detect_res, label, class_num, background_label=0,
 
     nn_layers.py_func(compute, [detect_res, label], [out])
     return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """(reference: layers/detection.py:1972). Static-shape outputs:
+    (rpn_rois [N, post, 4], rpn_roi_probs [N, post, 1]) zero-padded past
+    each image's proposal count — pass return_rois_num=True to also get
+    the [N] per-image count and mask the padding downstream. ``eta``
+    (adaptive NMS) is accepted but unsupported under static shapes."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = _out(helper)
+    probs = _out(helper)
+    count = _out(helper, "int32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisNum": [count]},
+        attrs={"pre_nms_topN": pre_nms_top_n,
+               "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size,
+               "eta": eta})
+    if return_rois_num:
+        return rois, probs, count
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """(reference: layers/detection.py:57). Static-shape per-anchor form:
+    returns (score_target [M] in {1, 0, -1}, bbox_target [M, 4],
+    bbox_weight [M, 1], loc_index [M], score_index [M])."""
+    helper = LayerHelper("rpn_target_assign")
+    score_t = _out(helper, "int32")
+    bbox_t = _out(helper)
+    bbox_w = _out(helper)
+    loc_i = _out(helper, "int64")
+    score_i = _out(helper, "int64")
+    inputs = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    helper.append_op(
+        type="rpn_target_assign", inputs=inputs,
+        outputs={"ScoreTarget": [score_t], "BboxTarget": [bbox_t],
+                 "BboxWeight": [bbox_w], "LocationIndex": [loc_i],
+                 "ScoreIndex": [score_i]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "use_random": use_random})
+    return score_t, bbox_t, bbox_w, loc_i, score_i

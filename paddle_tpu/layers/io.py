@@ -150,6 +150,10 @@ def open_files(filenames, shapes=None, lod_levels=None, dtypes=None,
 
     if isinstance(filenames, str):
         filenames = [filenames]
+    if bool(shapes) != bool(dtypes):
+        raise ValueError(
+            "open_files: give BOTH shapes and dtypes (to parse records "
+            "into arrays) or NEITHER (raw bytes)")
 
     def reader():
         for _ in range(pass_num):
@@ -199,7 +203,7 @@ def random_data_generator(low, high, shapes, lod_levels=None,
     import numpy as np
 
     def reader():
-        rng = np.random.RandomState(0)
+        rng = np.random.RandomState()  # fresh stream per reader instance
         while True:
             yield tuple(
                 rng.uniform(low, high, s).astype(np.float32)

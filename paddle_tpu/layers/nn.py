@@ -2318,20 +2318,24 @@ def load(out, file_path, load_as_fp16=None):
     import numpy as np
 
     from paddle_tpu import compat
+    from paddle_tpu.ops.misc_ops import register_load_value
 
-    try:
-        arr = compat.load_reference_var(file_path)
-    except Exception:
+    # dispatch on magic bytes: .npy starts with \x93NUMPY, the reference
+    # tensor stream with its uint32 version (0) — so real parse errors in
+    # either format surface instead of being masked by a fallback
+    with open(file_path, "rb") as f:
+        magic = f.read(6)
+    if magic.startswith(b"\x93NUMPY"):
         arr = np.load(file_path)
+    else:
+        arr = compat.load_reference_var(file_path)
     if load_as_fp16:
         arr = arr.astype(np.float16)
     helper = LayerHelper("load")
     helper.append_op(
-        type="assign_value", inputs={},
+        type="load_value", inputs={},
         outputs={"Out": [out]},
-        attrs={"shape": list(arr.shape),
-               "values": arr.reshape(-1).tolist(),
-               "dtype": str(arr.dtype)})
+        attrs={"value_id": register_load_value(arr)})
     return out
 
 

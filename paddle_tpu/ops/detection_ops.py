@@ -639,3 +639,163 @@ def yolov3_loss(ctx, ins, attrs):
     return {"Loss": [loss.astype(x.dtype)],
             "ObjectnessMask": [obj_mask],
             "GTMatchMask": [jnp.where(valid, mask_idx, -1)]}
+
+
+@register_no_grad_op("generate_proposals")
+def generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (reference:
+    detection/generate_proposals_op.cc): per image take the
+    pre_nms_topN-scored anchors, decode deltas (box_coder
+    decode_center_size with variances), clip to the image, drop boxes
+    smaller than min_size at image scale, greedy-NMS, keep
+    post_nms_topN. Static-shape outputs: RpnRois [N, post, 4] /
+    RpnRoiProbs [N, post, 1] zero-padded plus RpnRoisNum [N]."""
+    scores = single(ins, "Scores")        # [N, A, H, W]
+    deltas = single(ins, "BboxDeltas")    # [N, 4A, H, W]
+    im_info = single(ins, "ImInfo")       # [N, 3] (h, w, scale)
+    anchors = single(ins, "Anchors").reshape(-1, 4)     # [A*H*W, 4]
+    variances = single(ins, "Variances").reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = float(attrs.get("min_size", 0.1))
+    # adaptive-eta NMS (threshold decay per round) is data-dependent and
+    # unsupported under static shapes; standard fixed-threshold NMS runs
+    attrs.pop("eta", None)
+    N = scores.shape[0]
+    A, H, W = scores.shape[1], scores.shape[2], scores.shape[3]
+    total = A * H * W
+    pre_n = min(pre_n, total)
+
+    # anchors are laid out [H, W, A, 4] by anchor_generator; scores come
+    # [A, H, W] -> align scores/deltas to the anchor order
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, total)         # [N, HWA]
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2)
+    dl = dl.reshape(N, total, 4)
+
+    def one(sc_i, dl_i, info):
+        top_s, idx = lax.top_k(sc_i, pre_n)
+        anc = anchors[idx]
+        var = variances[idx]
+        d = dl_i[idx] * var
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw / 2.0
+        acy = anc[:, 1] + ah / 2.0
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        # reference clips dw/dh at log(1000/16) before exp
+        bw = jnp.exp(jnp.minimum(d[:, 2], jnp.log(1000.0 / 16.0))) * aw
+        bh = jnp.exp(jnp.minimum(d[:, 3], jnp.log(1000.0 / 16.0))) * ah
+        x1 = jnp.clip(cx - bw / 2.0, 0.0, info[1] - 1.0)
+        y1 = jnp.clip(cy - bh / 2.0, 0.0, info[0] - 1.0)
+        x2 = jnp.clip(cx + bw / 2.0 - 1.0, 0.0, info[1] - 1.0)
+        y2 = jnp.clip(cy + bh / 2.0 - 1.0, 0.0, info[0] - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        ms = min_size * info[2]
+        keep_size = ((x2 - x1 + 1.0) >= ms) & ((y2 - y1 + 1.0) >= ms)
+        s_kept = jnp.where(keep_size, top_s, -jnp.inf)
+        iou = _pairwise_iou(boxes, boxes, normalized=False)
+
+        def body(i, keep):
+            sup = jnp.any((iou[i] > nms_thresh) & keep)
+            return keep.at[i].set(jnp.isfinite(s_kept[i]) & ~sup)
+
+        keep = lax.fori_loop(0, pre_n, body, jnp.zeros((pre_n,), bool))
+        final_s = jnp.where(keep, s_kept, -jnp.inf)
+        k = min(post_n, pre_n)
+        sel_s, sel_i = lax.top_k(final_s, k)
+        ok = jnp.isfinite(sel_s)
+        rois = jnp.where(ok[:, None], boxes[sel_i], 0.0)
+        probs = jnp.where(ok, sel_s, 0.0)[:, None]
+        if k < post_n:
+            rois = jnp.pad(rois, ((0, post_n - k), (0, 0)))
+            probs = jnp.pad(probs, ((0, post_n - k), (0, 0)))
+            ok = jnp.pad(ok, (0, post_n - k))
+        return rois, probs, jnp.sum(ok).astype(jnp.int32)
+
+    rois, probs, counts = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs],
+            "RpnRoisNum": [counts]}
+
+
+@register_no_grad_op("rpn_target_assign", needs_rng=True)
+def rpn_target_assign(ctx, ins, attrs):
+    """RPN training target sampling (reference:
+    detection/rpn_target_assign_op.cc): anchors with IoU >= pos_thresh
+    (plus each gt's best anchor) are positives, IoU < neg_thresh
+    negatives; subsample to rpn_batch_size_per_im at rpn_fg_fraction.
+    Static-shape outputs: per-anchor ScoreTarget (1 pos, 0 neg,
+    -1 ignore) and per-anchor BboxTarget/weights."""
+    anchors = single(ins, "Anchor").reshape(-1, 4)      # [M, 4]
+    gt_boxes = single(ins, "GtBoxes")                   # [G, 4]
+    is_crowd = ins.get("IsCrowd", [None])
+    im_info = ins.get("ImInfo", [None])
+    batch_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_thresh = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thresh = float(attrs.get("rpn_negative_overlap", 0.3))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
+    use_random = bool(attrs.get("use_random", True))
+    M = anchors.shape[0]
+    valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0]) & (
+        gt_boxes[:, 3] > gt_boxes[:, 1])
+    if is_crowd and is_crowd[0] is not None:
+        valid_gt = valid_gt & (is_crowd[0].reshape(-1) == 0)
+
+    # anchors straddling the image boundary by more than the threshold
+    # are excluded from sampling entirely (reference: straddle_thresh)
+    inside = jnp.ones((M,), bool)
+    if im_info and im_info[0] is not None and straddle >= 0:
+        info = im_info[0].reshape(-1)
+        img_h, img_w = info[0], info[1]
+        inside = ((anchors[:, 0] >= -straddle)
+                  & (anchors[:, 1] >= -straddle)
+                  & (anchors[:, 2] < img_w + straddle)
+                  & (anchors[:, 3] < img_h + straddle))
+
+    iou = _pairwise_iou(anchors, gt_boxes, normalized=False)  # [M, G]
+    iou = jnp.where(valid_gt[None, :], iou, 0.0)
+    iou = jnp.where(inside[:, None], iou, 0.0)
+    best_iou = jnp.max(iou, axis=1)
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    pos = (best_iou >= pos_thresh) & inside
+    # each valid gt's best anchor is positive too
+    gt_best_anchor = jnp.argmax(iou, axis=0).astype(jnp.int32)  # [G]
+    pos = pos.at[gt_best_anchor].set(
+        jnp.where(valid_gt, True, pos[gt_best_anchor]), mode="drop")
+    neg = (best_iou < neg_thresh) & ~pos & inside
+
+    # subsample like the reference sampler: at most fg_frac*batch
+    # positives, then fill the REMAINING budget with negatives
+    fg_cap = int(batch_per_im * fg_frac)
+    priority = (jax.random.uniform(ctx.rng(), (M,)) if use_random
+                else jnp.arange(M, dtype=jnp.float32) / M)
+    pos_rank = jnp.argsort(jnp.argsort(jnp.where(pos, priority, 2.0)))
+    pos = pos & (pos_rank < fg_cap)
+    bg_cap = batch_per_im - jnp.sum(pos)
+    neg_rank = jnp.argsort(jnp.argsort(jnp.where(neg, priority, 2.0)))
+    neg = neg & (neg_rank < bg_cap)
+
+    score_target = jnp.where(pos, 1, jnp.where(neg, 0, -1)).astype(
+        jnp.int32)
+    # bbox regression targets for positives (encode_center_size)
+    g = gt_boxes[best_gt]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw / 2.0
+    acy = anchors[:, 1] + ah / 2.0
+    gw = jnp.maximum(g[:, 2] - g[:, 0] + 1.0, 1.0)
+    gh = jnp.maximum(g[:, 3] - g[:, 1] + 1.0, 1.0)
+    gcx = g[:, 0] + gw / 2.0
+    gcy = g[:, 1] + gh / 2.0
+    tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                     jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+    w = pos[:, None].astype(jnp.float32)
+    return {"ScoreTarget": [score_target],
+            "BboxTarget": [jnp.where(pos[:, None], tgt, 0.0)],
+            "BboxWeight": [w],
+            "LocationIndex": [jnp.where(pos, jnp.arange(M), -1).astype(
+                jnp.int64)],
+            "ScoreIndex": [jnp.where(pos | neg, jnp.arange(M), -1).astype(
+                jnp.int64)]}

@@ -794,11 +794,19 @@ def psroi_pool(ctx, ins, attrs):
 # Output shapes must be static (callback contract).
 
 _PY_FUNC_REGISTRY = {}
+_PY_FUNC_IDS = {}
 
 
 def register_py_func(fn):
+    # dedup by identity so program rebuilds reusing the same callable
+    # (notebook loops) do not grow the registry; the registry's strong
+    # reference keeps id(fn) stable
+    fid = _PY_FUNC_IDS.get(id(fn))
+    if fid is not None and _PY_FUNC_REGISTRY.get(fid) is fn:
+        return fid
     fid = len(_PY_FUNC_REGISTRY)
     _PY_FUNC_REGISTRY[fid] = fn
+    _PY_FUNC_IDS[id(fn)] = fid
     return fid
 
 
@@ -853,3 +861,20 @@ def py_func_grad(ctx, ins, attrs):
 
     grads = jax.pure_callback(host_fn, result_shapes, *xs, *ogs)
     return {"X@GRAD": list(grads)}
+
+
+# load(): the array is kept in a host-side registry and lowered as an XLA
+# constant — embedding multi-MB tensors as python lists in op attrs (the
+# assign_value route) would bloat the program desc.
+_LOAD_REGISTRY = {}
+
+
+def register_load_value(arr):
+    vid = len(_LOAD_REGISTRY)
+    _LOAD_REGISTRY[vid] = arr
+    return vid
+
+
+@register_no_grad_op("load_value")
+def load_value(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(_LOAD_REGISTRY[int(attrs["value_id"])])]}

@@ -419,3 +419,58 @@ def test_yolov3_loss_oracle():
                 elif obj_target[j, k, li] > -0.5:
                     expect += sce(xr[0, j, 4, k, li], 0.0)
     np.testing.assert_allclose(np.asarray(loss_v)[0], expect, rtol=1e-4)
+
+
+def test_generate_proposals_and_rpn_target_assign():
+    """RPN pipeline: anchors -> proposals around a strong-activation
+    region; target assignment marks the overlapping anchors positive."""
+    N, A, H, W = 1, 3, 4, 4
+    rng = np.random.RandomState(0)
+    # anchors via anchor_generator over a 4x4 map, stride 8 -> 32px image
+    feat = np.zeros((N, 8, H, W), np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    # scores: make one location/anchor clearly dominant
+    scores = np.full((N, A, H, W), -5.0, np.float32)
+    scores[0, 1, 2, 2] = 5.0
+    deltas = np.zeros((N, 4 * A, H, W), np.float32)
+    gt = np.array([[10.0, 10.0, 24.0, 24.0]], np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        f = fluid.layers.data(name="f", shape=[8, H, W], dtype="float32")
+        s = fluid.layers.data(name="s", shape=[A, H, W], dtype="float32")
+        d = fluid.layers.data(name="d", shape=[4 * A, H, W],
+                              dtype="float32")
+        info = fluid.layers.data(name="info", shape=[3], dtype="float32")
+        g = fluid.layers.data(name="g", shape=[4], dtype="float32")
+        anchors, avar = fluid.layers.anchor_generator(
+            f, anchor_sizes=[16.0], aspect_ratios=[0.5, 1.0, 2.0],
+            stride=[8.0, 8.0])
+        rois, probs = fluid.layers.generate_proposals(
+            s, d, info, anchors, avar, pre_nms_top_n=16,
+            post_nms_top_n=5, nms_thresh=0.5, min_size=2.0)
+        st, bt, bw, li, si = fluid.layers.rpn_target_assign(
+            None, None, anchors, avar, g,
+            rpn_positive_overlap=0.5, rpn_negative_overlap=0.3)
+        return_list = [rois, probs, st, bt]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rois_v, probs_v, st_v, bt_v = exe.run(
+            main, feed={"f": feat, "s": scores, "d": deltas,
+                        "info": im_info, "g": gt},
+            fetch_list=return_list)
+    rois_v = np.asarray(rois_v)
+    probs_v = np.asarray(probs_v)
+    # the top proposal decodes the dominant anchor at cell (2,2)
+    assert probs_v[0, 0, 0] > 0.9
+    top = rois_v[0, 0]
+    assert 0 <= top[0] <= top[2] <= 31 and 0 <= top[1] <= top[3] <= 31
+    # target assignment: at least one positive anchor, negatives present,
+    # and every positive's bbox target is finite
+    st_v = np.asarray(st_v)
+    bt_v = np.asarray(bt_v)
+    assert (st_v == 1).sum() >= 1
+    assert (st_v == 0).sum() >= 1
+    assert np.isfinite(bt_v[st_v == 1]).all()

@@ -500,3 +500,22 @@ def test_chunk_eval_iobes_adjacent_chunks():
 
     (nl,) = _run(build, {"i": lab, "l": lab})
     assert int(nl[0]) == 2, int(nl[0])
+
+
+def test_load_layer_npy_and_reference_stream(tmp_path):
+    arr = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    npy = str(tmp_path / "w.npy")
+    np.save(npy, arr)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        block = main.global_block()
+        out = block.create_var(name="loaded_w", shape=[4, 3],
+                               dtype="float32")
+        fluid.layers.load(out, npy)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (v,) = exe.run(main, feed={}, fetch_list=["loaded_w"])
+    np.testing.assert_allclose(np.asarray(v), arr)
