@@ -28,6 +28,7 @@ __all__ = [
     "detection_map",
     "generate_proposals",
     "rpn_target_assign",
+    "generate_proposal_labels",
 ]
 
 
@@ -562,9 +563,14 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
                       rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
                       rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
                       rpn_negative_overlap=0.3, use_random=True):
-    """(reference: layers/detection.py:57). Static-shape per-anchor form:
-    returns (score_target [M] in {1, 0, -1}, bbox_target [M, 4],
-    bbox_weight [M, 1], loc_index [M], score_index [M])."""
+    """(reference: layers/detection.py:57). With bbox_pred/cls_logits
+    given, returns the REFERENCE 5-tuple (score_pred [M, 1],
+    loc_pred [M, 4], score_target [M, 1] in {1, 0, -1(ignore)},
+    loc_target [M, 4], bbox_inside_weight [M, 1]) in dense per-anchor
+    form — mask score terms where score_target < 0 and weight location
+    terms by bbox_inside_weight, instead of the reference's gathered
+    subsets. With preds omitted, returns the raw per-anchor targets
+    (score_target, bbox_target, bbox_weight, loc_index, score_index)."""
     helper = LayerHelper("rpn_target_assign")
     score_t = _out(helper, "int32")
     bbox_t = _out(helper)
@@ -587,4 +593,50 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
                "rpn_negative_overlap": rpn_negative_overlap,
                "rpn_straddle_thresh": rpn_straddle_thresh,
                "use_random": use_random})
+    if bbox_pred is not None and cls_logits is not None:
+        from paddle_tpu.layers import nn as nn_layers
+
+        score_pred = nn_layers.reshape(cls_logits, shape=[-1, 1])
+        loc_pred = nn_layers.reshape(bbox_pred, shape=[-1, 4])
+        score_tgt = nn_layers.reshape(score_t, shape=[-1, 1])
+        return score_pred, loc_pred, score_tgt, bbox_t, bbox_w
     return score_t, bbox_t, bbox_w, loc_i, score_i
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, rpn_rois_num=None,
+                             batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """(reference: layers/detection.py:1743). Static single-image form:
+    returns (rois [P, 4], labels_int32 [P], bbox_targets
+    [P, 4*class_nums], bbox_inside_weights, bbox_outside_weights) with
+    P = batch_size_per_im; padding rows carry label -1, zero weights."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = _out(helper)
+    labels = _out(helper, "int32")
+    tgts = _out(helper)
+    in_w = _out(helper)
+    out_w = _out(helper)
+    inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+              "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    if rpn_rois_num is not None:
+        inputs["RpnRoisNum"] = [rpn_rois_num]
+    helper.append_op(
+        type="generate_proposal_labels", inputs=inputs,
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [tgts], "BboxInsideWeights": [in_w],
+                 "BboxOutsideWeights": [out_w]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums or 81,
+               "use_random": use_random})
+    return rois, labels, tgts, in_w, out_w

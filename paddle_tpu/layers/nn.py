@@ -158,6 +158,7 @@ __all__ = [
     "py_func",
     "load",
     "reorder_lod_tensor_by_rank",
+    "similarity_focus",
 ]
 
 
@@ -2315,27 +2316,19 @@ def load(out, file_path, load_as_fp16=None):
     at run time). Here the file is read eagerly at build time (reference
     tensor-stream or .npy) and assigned as the var's init value via an
     assign op on first run."""
-    import numpy as np
+    from paddle_tpu.ops.misc_ops import (_load_from_file,
+                                         register_load_value)
 
-    from paddle_tpu import compat
-    from paddle_tpu.ops.misc_ops import register_load_value
-
-    # dispatch on magic bytes: .npy starts with \x93NUMPY, the reference
-    # tensor stream with its uint32 version (0) — so real parse errors in
-    # either format surface instead of being masked by a fallback
-    with open(file_path, "rb") as f:
-        magic = f.read(6)
-    if magic.startswith(b"\x93NUMPY"):
-        arr = np.load(file_path)
-    else:
-        arr = compat.load_reference_var(file_path)
-    if load_as_fp16:
-        arr = arr.astype(np.float16)
+    # eager read (errors surface at build time); the op re-reads by path
+    # after deserialization in a fresh process
+    arr = _load_from_file(file_path, bool(load_as_fp16))
+    register_load_value(arr, file_path, bool(load_as_fp16))
     helper = LayerHelper("load")
     helper.append_op(
         type="load_value", inputs={},
         outputs={"Out": [out]},
-        attrs={"value_id": register_load_value(arr)})
+        attrs={"file_path": file_path,
+               "load_as_fp16": bool(load_as_fp16)})
     return out
 
 
@@ -2345,3 +2338,13 @@ def reorder_lod_tensor_by_rank(x, rank_table):
     masked scans make reordering unnecessary (see DynamicRNN) — so this
     is the identity."""
     return x
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """(reference: layers/nn.py similarity_focus)"""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="similarity_focus", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "indexes": list(indexes)})
+    return out

@@ -224,6 +224,30 @@ def box_coder(ctx, ins, attrs):
     return {"OutputBox": [out]}
 
 
+def _encode_center_size(rois, gts, weights=None):
+    """Center-size box encoding with the +1 pixel convention, shared by
+    box_coder/rpn_target_assign/generate_proposal_labels (reference:
+    detection/box_coder_op.h EncodeCenterSize)."""
+    rw = jnp.maximum(rois[:, 2] - rois[:, 0] + 1.0, 1.0)
+    rh = jnp.maximum(rois[:, 3] - rois[:, 1] + 1.0, 1.0)
+    rcx, rcy = rois[:, 0] + rw / 2.0, rois[:, 1] + rh / 2.0
+    gw = jnp.maximum(gts[:, 2] - gts[:, 0] + 1.0, 1.0)
+    gh = jnp.maximum(gts[:, 3] - gts[:, 1] + 1.0, 1.0)
+    gcx, gcy = gts[:, 0] + gw / 2.0, gts[:, 1] + gh / 2.0
+    tgt = jnp.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                     jnp.log(gw / rw), jnp.log(gh / rh)], axis=1)
+    if weights is not None:
+        tgt = tgt / jnp.asarray(weights, jnp.float32)[None, :]
+    return tgt
+
+
+def _subsample(mask, cap, priority):
+    """Keep at most ``cap`` True entries of ``mask``, chosen by ascending
+    ``priority`` (the reference's shuffle-and-truncate sampler)."""
+    rank = jnp.argsort(jnp.argsort(jnp.where(mask, priority, 2.0)))
+    return mask & (rank < cap)
+
+
 def _pairwise_iou(x, y, normalized=True):
     """x: [N, 4], y: [M, 4] -> [N, M] IoU (reference:
     detection/iou_similarity_op.h IOUSimilarityFunctor)."""
@@ -760,10 +784,13 @@ def rpn_target_assign(ctx, ins, attrs):
     best_iou = jnp.max(iou, axis=1)
     best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
     pos = (best_iou >= pos_thresh) & inside
-    # each valid gt's best anchor is positive too
+    # each valid gt's best anchor is positive too — but only when it
+    # actually overlaps (an all-straddling neighborhood must not promote
+    # the arbitrary argmax anchor 0)
     gt_best_anchor = jnp.argmax(iou, axis=0).astype(jnp.int32)  # [G]
-    pos = pos.at[gt_best_anchor].set(
-        jnp.where(valid_gt, True, pos[gt_best_anchor]), mode="drop")
+    gt_has_overlap = jnp.max(iou, axis=0) > 0.0
+    pos = pos.at[gt_best_anchor].max(
+        valid_gt & gt_has_overlap, mode="drop")
     neg = (best_iou < neg_thresh) & ~pos & inside
 
     # subsample like the reference sampler: at most fg_frac*batch
@@ -771,26 +798,13 @@ def rpn_target_assign(ctx, ins, attrs):
     fg_cap = int(batch_per_im * fg_frac)
     priority = (jax.random.uniform(ctx.rng(), (M,)) if use_random
                 else jnp.arange(M, dtype=jnp.float32) / M)
-    pos_rank = jnp.argsort(jnp.argsort(jnp.where(pos, priority, 2.0)))
-    pos = pos & (pos_rank < fg_cap)
-    bg_cap = batch_per_im - jnp.sum(pos)
-    neg_rank = jnp.argsort(jnp.argsort(jnp.where(neg, priority, 2.0)))
-    neg = neg & (neg_rank < bg_cap)
+    pos = _subsample(pos, fg_cap, priority)
+    neg = _subsample(neg, batch_per_im - jnp.sum(pos), priority)
 
     score_target = jnp.where(pos, 1, jnp.where(neg, 0, -1)).astype(
         jnp.int32)
     # bbox regression targets for positives (encode_center_size)
-    g = gt_boxes[best_gt]
-    aw = anchors[:, 2] - anchors[:, 0] + 1.0
-    ah = anchors[:, 3] - anchors[:, 1] + 1.0
-    acx = anchors[:, 0] + aw / 2.0
-    acy = anchors[:, 1] + ah / 2.0
-    gw = jnp.maximum(g[:, 2] - g[:, 0] + 1.0, 1.0)
-    gh = jnp.maximum(g[:, 3] - g[:, 1] + 1.0, 1.0)
-    gcx = g[:, 0] + gw / 2.0
-    gcy = g[:, 1] + gh / 2.0
-    tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
-                     jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+    tgt = _encode_center_size(anchors, gt_boxes[best_gt])
     w = pos[:, None].astype(jnp.float32)
     return {"ScoreTarget": [score_target],
             "BboxTarget": [jnp.where(pos[:, None], tgt, 0.0)],
@@ -799,3 +813,130 @@ def rpn_target_assign(ctx, ins, attrs):
                 jnp.int64)],
             "ScoreIndex": [jnp.where(pos | neg, jnp.arange(M), -1).astype(
                 jnp.int64)]}
+
+
+@register_no_grad_op("generate_proposal_labels", needs_rng=True)
+def generate_proposal_labels(ctx, ins, attrs):
+    """Second-stage RoI sampling (reference:
+    detection/generate_proposal_labels_op.cc): gt boxes join the
+    candidate rois; rois with IoU >= fg_thresh are foreground (labeled
+    by their best gt), IoU in [bg_thresh_lo, bg_thresh_hi) background;
+    subsample to batch_size_per_im at fg_fraction. Static-shape single
+    image form: outputs exactly batch_size_per_im rows (padding rows are
+    label -1 with zero weights)."""
+    rois = single(ins, "RpnRois").reshape(-1, 4)        # [R, 4]
+    gt_classes = single(ins, "GtClasses").reshape(-1).astype(jnp.int32)
+    gt_boxes = single(ins, "GtBoxes").reshape(-1, 4)    # [G, 4]
+    is_crowd = ins.get("IsCrowd", [None])
+    im_info = ins.get("ImInfo", [None])
+    rois_num = ins.get("RpnRoisNum", [None])
+    if im_info and im_info[0] is not None:
+        # proposals arrive at scaled-image coordinates; gts are at the
+        # original scale (reference divides by im_scale)
+        rois = rois / im_info[0].reshape(-1)[2]
+    batch = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    weights = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+
+    valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0]) & (
+        gt_boxes[:, 3] > gt_boxes[:, 1])
+    if is_crowd and is_crowd[0] is not None:
+        valid_gt = valid_gt & (is_crowd[0].reshape(-1) == 0)
+    # upstream zero-padding (generate_proposals pads past each image's
+    # proposal count) must never be sampled: honor RpnRoisNum when given
+    # and always drop degenerate boxes
+    roi_valid = (rois[:, 2] > rois[:, 0]) & (rois[:, 3] > rois[:, 1])
+    if rois_num and rois_num[0] is not None:
+        roi_valid = roi_valid & (
+            jnp.arange(rois.shape[0]) < rois_num[0].reshape(()))
+    # gt boxes are candidates too (reference concatenates them); pad the
+    # pool so selection always yields exactly batch rows
+    cand = jnp.concatenate([rois, gt_boxes], axis=0)
+    cand_valid = jnp.concatenate([roi_valid, valid_gt])
+    n_real = cand.shape[0]
+    if n_real < batch:
+        cand = jnp.concatenate(
+            [cand, jnp.full((batch - n_real, 4), -1.0, cand.dtype)],
+            axis=0)
+        cand_valid = jnp.concatenate(
+            [cand_valid, jnp.zeros((batch - n_real,), bool)])
+    R = cand.shape[0]
+    valid_cand = cand_valid
+    iou = _pairwise_iou(cand, gt_boxes, normalized=False)
+    iou = jnp.where(valid_gt[None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=1)
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    fg = (best_iou >= fg_thresh) & valid_cand
+    bg = ((best_iou < bg_hi) & (best_iou >= bg_lo) & ~fg & valid_cand)
+
+    fg_cap = int(batch * fg_frac)
+    priority = (jax.random.uniform(ctx.rng(), (R,)) if use_random
+                else jnp.arange(R, dtype=jnp.float32) / R)
+    fg = _subsample(fg, fg_cap, priority)
+    bg = _subsample(bg, batch - jnp.sum(fg), priority)
+
+    # order sampled rois: foregrounds first, then backgrounds, then pad
+    order_key = jnp.where(fg, 0.0, jnp.where(bg, 1.0, 2.0)) + priority
+    sel = jnp.argsort(order_key)[:batch]
+    sel_fg = fg[sel]
+    sel_bg = bg[sel]
+    out_rois = jnp.where((sel_fg | sel_bg)[:, None], cand[sel], 0.0)
+    labels = jnp.where(sel_fg, gt_classes[best_gt[sel]],
+                       jnp.where(sel_bg, 0, -1)).astype(jnp.int32)
+
+    # bbox targets: encode best gt against the roi, expanded per class
+    tgt = _encode_center_size(cand[sel], gt_boxes[best_gt[sel]], weights)
+    cls = jnp.maximum(labels, 0)
+    col = jnp.arange(4)[None, :] + 4 * cls[:, None]     # [P, 4]
+    bbox_targets = jnp.zeros((batch, 4 * class_nums), jnp.float32)
+    rows_i = jnp.arange(batch)[:, None]
+    bbox_targets = bbox_targets.at[rows_i, col].set(
+        jnp.where(sel_fg[:, None], tgt, 0.0), mode="drop")
+    inside_w = jnp.zeros_like(bbox_targets).at[rows_i, col].set(
+        jnp.where(sel_fg[:, None], 1.0, 0.0), mode="drop")
+    return {"Rois": [out_rois],
+            "LabelsInt32": [labels],
+            "BboxTargets": [bbox_targets],
+            "BboxInsideWeights": [inside_w],
+            "BboxOutsideWeights": [inside_w]}
+
+
+@register_op("similarity_focus", no_grad_inputs=())
+def similarity_focus(ctx, ins, attrs):
+    """(reference: operators/similarity_focus_op.h): for each selected
+    channel, greedily pick per-(h, w) maxima such that every row and
+    column is used at most once; the union of picked positions becomes a
+    {0,1} mask broadcast across all channels."""
+    x = single(ins, "X")                     # [N, C, H, W]
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs["indexes"]]
+    if axis != 1:
+        raise NotImplementedError("similarity_focus supports axis=1")
+    N, C, H, W = x.shape
+    steps = min(H, W)
+
+    def one_image(img):                      # [C, H, W]
+        mask = jnp.zeros((H, W), bool)
+        for c in indexes:
+            plane = img[c]
+
+            def body(_, carry):
+                m, row_used, col_used = carry
+                avail = (~row_used[:, None]) & (~col_used[None, :])
+                v = jnp.where(avail, plane, -jnp.inf)
+                flat = jnp.argmax(v)
+                i, j = flat // W, flat % W
+                m = m.at[i, j].set(True)
+                return m, row_used.at[i].set(True), col_used.at[j].set(True)
+
+            mask, _, _ = lax.fori_loop(
+                0, steps, body,
+                (mask, jnp.zeros((H,), bool), jnp.zeros((W,), bool)))
+        return jnp.broadcast_to(mask[None], (C, H, W)).astype(x.dtype)
+
+    return {"Out": [jax.vmap(one_image)(x)]}

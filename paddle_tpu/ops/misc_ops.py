@@ -863,18 +863,38 @@ def py_func_grad(ctx, ins, attrs):
     return {"X@GRAD": list(grads)}
 
 
-# load(): the array is kept in a host-side registry and lowered as an XLA
-# constant — embedding multi-MB tensors as python lists in op attrs (the
-# assign_value route) would bloat the program desc.
+# load(): the array is kept in a host-side registry keyed by
+# (file_path, fp16) and lowered as an XLA constant — embedding multi-MB
+# tensors as python lists in op attrs (the assign_value route) would
+# bloat the program desc. The file path rides in the op attrs, so a
+# DESERIALIZED program (fresh process, empty registry) transparently
+# re-reads the file; repeated load() of the same file reuses one entry.
 _LOAD_REGISTRY = {}
 
 
-def register_load_value(arr):
-    vid = len(_LOAD_REGISTRY)
-    _LOAD_REGISTRY[vid] = arr
-    return vid
+def register_load_value(arr, file_path, fp16):
+    _LOAD_REGISTRY[(file_path, bool(fp16))] = arr
+
+
+def _load_from_file(file_path, fp16):
+    import numpy as np
+
+    from paddle_tpu import compat
+
+    with open(file_path, "rb") as f:
+        magic = f.read(6)
+    if magic.startswith(b"\x93NUMPY"):
+        arr = np.load(file_path)
+    else:
+        arr = compat.load_reference_var(file_path)
+    return arr.astype(np.float16) if fp16 else arr
 
 
 @register_no_grad_op("load_value")
 def load_value(ctx, ins, attrs):
-    return {"Out": [jnp.asarray(_LOAD_REGISTRY[int(attrs["value_id"])])]}
+    key = (attrs["file_path"], bool(attrs.get("load_as_fp16", False)))
+    arr = _LOAD_REGISTRY.get(key)
+    if arr is None:
+        arr = _load_from_file(*key)
+        _LOAD_REGISTRY[key] = arr
+    return {"Out": [jnp.asarray(arr)]}

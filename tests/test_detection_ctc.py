@@ -474,3 +474,114 @@ def test_generate_proposals_and_rpn_target_assign():
     assert (st_v == 1).sum() >= 1
     assert (st_v == 0).sum() >= 1
     assert np.isfinite(bt_v[st_v == 1]).all()
+
+
+def test_generate_proposal_labels_sampling():
+    rois = np.array([[0, 0, 10, 10],     # IoU 1.0 with gt0 -> fg
+                     [1, 1, 11, 11],     # high IoU -> fg
+                     [40, 40, 50, 50],   # IoU 0 -> bg
+                     [60, 60, 70, 70]],  # IoU 0 -> bg
+                    np.float32)
+    gt_boxes = np.array([[0, 0, 10, 10]], np.float32)
+    gt_classes = np.array([[3]], np.int64)
+
+    def build():
+        r = fluid.layers.data(name="r", shape=[4], dtype="float32")
+        gc = fluid.layers.data(name="gc", shape=[1], dtype="int64")
+        gb = fluid.layers.data(name="gb", shape=[4], dtype="float32")
+        outs = fluid.layers.generate_proposal_labels(
+            r, gc, None, gb, batch_size_per_im=8, fg_fraction=0.5,
+            fg_thresh=0.5, class_nums=5, use_random=False)
+        return list(outs)
+
+    rois_v, labels_v, tgts_v, inw_v, outw_v = _run(
+        build, {"r": rois, "gc": gt_classes, "gb": gt_boxes})
+    labels_v = np.asarray(labels_v)
+    # fg rois labeled with gt class 3; bgs labeled 0; padding -1
+    assert (labels_v == 3).sum() >= 2
+    assert (labels_v == 0).sum() >= 2
+    assert (labels_v == -1).sum() >= 1
+    # fg rows place their 4 targets in class-3 columns with weight 1
+    tgts_v, inw_v = np.asarray(tgts_v), np.asarray(inw_v)
+    fg_rows = np.where(labels_v == 3)[0]
+    assert inw_v[fg_rows][:, 12:16].sum() == 4 * len(fg_rows)
+    assert np.isfinite(tgts_v).all()
+
+
+def test_similarity_focus_mask():
+    # one channel, 2x2: picks (argmax), then the only row/col-disjoint
+    # remaining cell
+    x = np.array([[[[0.9, 0.1], [0.2, 0.8]],
+                   [[0.0, 0.0], [0.0, 0.0]]]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[2, 2, 2], dtype="float32")
+        return [fluid.layers.similarity_focus(xv, axis=1, indexes=[0])]
+
+    (out,) = _run(build, {"x": x})
+    expect = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    np.testing.assert_allclose(out[0, 0], expect)
+    np.testing.assert_allclose(out[0, 1], expect)  # mask spans channels
+
+
+def test_proposal_labels_exclude_upstream_padding():
+    """Zero-padded proposal rows (from generate_proposals' static
+    output) must never be sampled as background."""
+    rois = np.array([[0, 0, 10, 10],
+                     [40, 40, 50, 50],
+                     [0, 0, 0, 0],       # upstream padding
+                     [0, 0, 0, 0]], np.float32)
+    rois_num = np.array([2], np.int32)
+    gt_boxes = np.array([[0, 0, 10, 10]], np.float32)
+    gt_classes = np.array([[1]], np.int64)
+
+    def build():
+        r = fluid.layers.data(name="r", shape=[4], dtype="float32")
+        rn = fluid.layers.data(name="rn", shape=[1], dtype="int32")
+        gc = fluid.layers.data(name="gc", shape=[1], dtype="int64")
+        gb = fluid.layers.data(name="gb", shape=[4], dtype="float32")
+        outs = fluid.layers.generate_proposal_labels(
+            r, gc, None, gb, rpn_rois_num=rn, batch_size_per_im=6,
+            fg_thresh=0.5, class_nums=3, use_random=False)
+        return [outs[0], outs[1]]
+
+    rois_v, labels_v = _run(build, {"r": rois, "rn": rois_num,
+                                    "gc": gt_classes, "gb": gt_boxes})
+    labels_v = np.asarray(labels_v)
+    rois_v = np.asarray(rois_v)
+    # sampled rows: fg (roi0 + the gt itself) and ONE bg (roi1); padding
+    # rows contribute nothing
+    sampled = rois_v[labels_v >= 0]
+    assert (labels_v == 0).sum() == 1
+    for row in sampled:
+        assert row[2] > row[0] and row[3] > row[1], row
+
+
+def test_rpn_target_assign_reference_tuple():
+    """With predictions given, the layer returns the reference 5-tuple
+    (score_pred, loc_pred, score_target, loc_target, weights)."""
+    feat = np.zeros((1, 4, 2, 2), np.float32)
+    gt = np.array([[2.0, 2.0, 12.0, 12.0]], np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        f = fluid.layers.data(name="f", shape=[4, 2, 2], dtype="float32")
+        g = fluid.layers.data(name="g", shape=[4], dtype="float32")
+        anchors, avar = fluid.layers.anchor_generator(
+            f, anchor_sizes=[8.0], aspect_ratios=[1.0], stride=[8.0, 8.0])
+        cls_logits = fluid.layers.conv2d(f, num_filters=1, filter_size=1)
+        bbox_pred = fluid.layers.conv2d(f, num_filters=4, filter_size=1)
+        sp, lp, st, lt, w = fluid.layers.rpn_target_assign(
+            bbox_pred, cls_logits, anchors, avar, g,
+            rpn_positive_overlap=0.3, rpn_negative_overlap=0.1)
+        fetch = [sp, lp, st, lt, w]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed={"f": feat, "g": gt}, fetch_list=fetch)
+    sp, lp, st, lt, w = map(np.asarray, outs)
+    M = 4  # 2x2 cells x 1 anchor
+    assert sp.shape == (M, 1) and lp.shape == (M, 4)
+    assert st.shape == (M, 1) and lt.shape == (M, 4) and w.shape == (M, 1)
+    assert set(np.unique(st)) <= {-1, 0, 1}
