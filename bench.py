@@ -146,8 +146,9 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=20):
         rng.randn(batch, heads, seq, dim).astype(np.float32))
 
     flash_g = jax.jit(jax.grad(
-        lambda a, b, c: jnp.sum(flash_attention(a, b, c, True, None,
-                                                128, 128, False)),
+        lambda a, b, c: jnp.sum(flash_attention(a, b, c, None, 0, True,
+                                                None, 0.0, 128, 128,
+                                                False)),
         argnums=(0, 1, 2)))
     xla_g = jax.jit(jax.grad(
         lambda a, b, c: jnp.sum(_xla_attention(a, b, c, True,

@@ -3,14 +3,30 @@
 Forward streams K/V blocks from VMEM against a resident Q block with
 online-softmax accumulation and emits the per-row logsumexp — O(T) memory,
 MXU-shaped contractions (the kernel the reference implements as
-math/softmax.cu + matmuls, fused here instead).
+math/softmax.cu + matmuls, fused here instead; fused-op strategy per
+paddle/fluid/operators/fused/).
 
 Backward is the FlashAttention-2 decomposition: a cheap XLA delta
 precompute (rowsum(dO*O)), a dQ kernel (Q block resident, K/V streamed)
 and a dK/dV kernel (K/V block resident, Q streamed), all re-deriving the
-softmax from the saved logsumexp instead of materializing the [T, T]
+softmax from the saved logsumexp instead of materializing the [Tq, Tk]
 probability matrix. The plain-XLA recompute path remains the fallback
 (PADDLE_TPU_FLASH_BWD=xla, or shapes the kernels cannot tile).
+
+Mosaic layout notes (what made round-2's kernels fail to lower on the
+real chip): every block's last two dims must be (8, 128)-tileable or span
+the full array dim. The logsumexp/delta residuals are therefore carried as
+``[B*H, Tq, _LSE_LANES]`` with the scalar replicated across the lane dim
+(the layout jax's own pallas flash kernel uses for its l/m residuals),
+never as rank-2 ``(1, block_q)`` blocks.
+
+Masking is TPU-first: key-padding masks are passed as per-sequence
+*lengths* living in SMEM (scalar memory), not as [B, H, T, T] additive
+tensors — the kernel compares against a key-position iota. Causal masking
+is a static flag. Attention dropout runs *inside* the kernel using a
+counter-based hash RNG (murmur3 finalizer over the global (batch, q, k)
+coordinate), so the forward and both backward kernels regenerate the
+identical mask from (seed, coords) with no [Tq, Tk] mask ever stored.
 
 ``fused_attention`` is the dispatch point: the Pallas kernel on TPU (or in
 interpreter mode for tests), the plain-XLA composition elsewhere.
@@ -30,14 +46,58 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 _NEG = -1e30
+# Lane width for the replicated logsumexp/delta residuals. 128 is the
+# layout jax's own flash kernel uses (MIN_BLOCK_SIZE); a full-dim lane of
+# 1 also lowers but 128 is the proven-safe default.
+_LSE_LANES = 128
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
-                 scale, block_q):
-    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+def _smem_spec():
+    if _HAS_PLTPU:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(memory_space=None)  # pragma: no cover
+
+
+def _keep_mask(seed, b, q_pos, k_pos, t_k, rate):
+    """Deterministic dropout keep-mask from the *global* (b, q, k)
+    coordinate: murmur3 finalizer bits -> uniform [0,1) -> >= rate.
+    Counter-based, so the dQ and dK/dV kernels reproduce the forward's
+    mask exactly regardless of their different iteration orders."""
+    idx = (q_pos * t_k + k_pos).astype(jnp.uint32)
+    h = idx ^ (seed.astype(jnp.uint32)
+               + jnp.uint32(0x9E3779B9) * (b + 1).astype(jnp.uint32))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    # 24-bit mantissa-safe uniform; via int32 (Mosaic has no uint32->f32)
+    u = (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
+    return u >= rate
+
+
+def _nk_limit(nk, causal_hi, length, block_k, masked, causal):
+    """Number of K blocks that can contribute: min over the causal frontier
+    and the valid-key frontier (both dynamic-friendly fori_loop bounds)."""
+    nk_eff = nk
+    if causal:
+        nk_eff = jnp.minimum(nk_eff, causal_hi)
+    if masked:
+        nk_eff = jnp.minimum(nk_eff, (length + block_k - 1) // block_k)
+    if causal or masked:
+        nk_eff = jnp.maximum(nk_eff, 1)
+    return nk_eff
+
+
+def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                 block_q, block_k, causal, scale, rate, masked):
+    b = pl.program_id(0)
     j = pl.program_id(1)
-    T = k_ref.shape[1]
-    nk = T // block_k
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    t_k = k_ref.shape[1]
+    nk = t_k // block_k
+    length = len_ref[b]
+    seed = seed_ref[0]
 
     q_pos = j * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -49,16 +109,23 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        k_pos = s * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            k_pos = s * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+        if masked:
+            sij = jnp.where(k_pos < length, sij, _NEG)
         m_new = jnp.maximum(m, jnp.max(sij, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(sij - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            keep = _keep_mask(seed, b, q_pos, k_pos, t_k, rate)
+            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - rate))
+        else:
+            p_acc = p
         acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p_acc, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
@@ -66,63 +133,74 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
 
-    if causal:
-        # blocks fully above the diagonal contribute nothing — skip them
-        nk_eff = jnp.minimum(
-            nk, (j + 1) * block_q // block_k + (1 if block_q % block_k else 0)
-        )
-        nk_eff = jnp.maximum(nk_eff, 1)
-    else:
-        nk_eff = nk
+    causal_hi = (j + 1) * block_q // block_k + (1 if block_q % block_k else 0)
+    nk_eff = _nk_limit(nk, causal_hi, length, block_k, masked, causal)
     acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # logsumexp per row, the softmax residual the backward kernels re-derive
-    # p from (FlashAttention-2's L)
-    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(block_q)
+    # p from (FlashAttention-2's L); replicated across the lane dim so the
+    # block stays (8, 128)-tileable
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [block_q, 1]
+    lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LSE_LANES))
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
-    B, H, T, D = q.shape
-    qr = q.reshape(B * H, T, D)
-    kr = k.reshape(B * H, T, D)
-    vr = v.reshape(B * H, T, D)
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    grid = (B * H, T // block_q)
+def _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate, block_q,
+                   block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    grid = (B * H, Tq // block_q)
+
+    masked = seq_lens is not None
+    if masked:
+        lens = jnp.repeat(jnp.maximum(seq_lens.astype(jnp.int32), 1), H)
+    else:
+        lens = jnp.full((B * H,), Tk, jnp.int32)
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1)
 
     kernel = functools.partial(
-        _attn_kernel, block_k=block_k, causal=causal, scale=scale,
-        block_q=block_q)
+        _attn_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, rate=rate, masked=masked)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
             jax.ShapeDtypeStruct(qr.shape, q.dtype),
-            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq, _LSE_LANES), jnp.float32),
         ],
         grid=grid,
         in_specs=[
+            _smem_spec(),
+            _smem_spec(),
             pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j: (b, j)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, j: (b, j, 0)),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(B, H, T, D), lse
+    )(lens, seed_arr, qr, kr, vr)
+    return out.reshape(B, H, Tq, D), lse
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k, causal, scale, block_q):
+def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, block_q, block_k, causal, scale,
+                   rate, masked):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)          # [block_q, D]
     do = do_ref[0].astype(jnp.float32)        # [block_q, D]
-    lse = lse_ref[0].reshape(block_q, 1)      # [block_q, 1]
-    delta = delta_ref[0].reshape(block_q, 1)  # [block_q, 1]
-    j = pl.program_id(1)
-    T = k_ref.shape[1]
-    nk = T // block_k
+    lse = lse_ref[0][:, :1]                   # [block_q, 1]
+    delta = delta_ref[0][:, :1]               # [block_q, 1]
+    t_k = k_ref.shape[1]
+    nk = t_k // block_k
+    length = len_ref[b]
+    seed = seed_ref[0]
     q_pos = j * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
@@ -132,37 +210,43 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        k_pos = s * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            k_pos = s * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+        if masked:
+            sij = jnp.where(k_pos < length, sij, _NEG)
         p = jnp.exp(sij - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            keep = _keep_mask(seed, b, q_pos, k_pos, t_k, rate)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - rate))
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        nk_eff = jnp.minimum(
-            nk, (j + 1) * block_q // block_k + (1 if block_q % block_k else 0))
-        nk_eff = jnp.maximum(nk_eff, 1)
-    else:
-        nk_eff = nk
+    causal_hi = (j + 1) * block_q // block_k + (1 if block_q % block_k else 0)
+    nk_eff = _nk_limit(nk, causal_hi, length, block_k, masked, causal)
     dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
     dq = jax.lax.fori_loop(0, nk_eff, body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_k, causal, scale, block_q):
+def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, block_q, block_k, causal,
+                    scale, rate, masked):
+    b = pl.program_id(0)
+    s_idx = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)       # [block_k, D]
     v_blk = v_ref[0].astype(jnp.float32)       # [block_k, D]
-    s_idx = pl.program_id(1)
-    T = q_ref.shape[1]
-    nq = T // block_q
+    t_q = q_ref.shape[1]
+    t_k = dk_ref.shape[1] * pl.num_programs(1)
+    nq = t_q // block_q
+    length = len_ref[b]
+    seed = seed_ref[0]
     k_pos = s_idx * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
 
@@ -170,23 +254,33 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(j * block_q, block_q)].reshape(block_q, 1)
-        delta = delta_ref[0, pl.ds(j * block_q, block_q)].reshape(
-            block_q, 1)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q), :][:, :1]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q), :][:, :1]
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        q_pos = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         if causal:
-            q_pos = j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
             sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+        if masked:
+            sij = jnp.where(k_pos < length, sij, _NEG)
         p = jnp.exp(sij - lse)                 # [block_q, block_k]
+        if rate > 0.0:
+            keep = _keep_mask(seed, b, q_pos, k_pos, t_k, rate)
+            inv = 1.0 / (1.0 - rate)
+            p_drop = jnp.where(keep, p, 0.0) * inv
+        else:
+            keep = None
+            p_drop = p
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_drop, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = jnp.where(keep, dp, 0.0) * inv
         ds = p * (dp - delta) * scale
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -205,80 +299,134 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
-    B, H, T, D = q.shape
-    qr, kr, vr = (x.reshape(B * H, T, D) for x in (q, k, v))
-    do = g.reshape(B * H, T, D)
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it
+def _flash_backward(q, k, v, out, lse, g, seq_lens, seed, causal, scale,
+                    rate, block_q, block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    do = g.reshape(B * H, Tq, D)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+
+    masked = seq_lens is not None
+    if masked:
+        lens = jnp.repeat(jnp.maximum(seq_lens.astype(jnp.int32), 1), H)
+    else:
+        lens = jnp.full((B * H,), Tk, jnp.int32)
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1)
+
+    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it; replicated
+    # across the lane dim like lse so its blocks stay Mosaic-tileable
     delta = jnp.sum(
-        do.astype(jnp.float32) * out.reshape(B * H, T, D).astype(
+        do.astype(jnp.float32) * out.reshape(B * H, Tq, D).astype(
             jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B * H, Tq, _LSE_LANES))
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
-                          scale=scale, block_q=block_q),
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale, rate=rate,
+                          masked=masked),
         out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
-        grid=(B * H, T // block_q),
+        grid=(B * H, Tq // block_q),
         in_specs=[
+            _smem_spec(),
+            _smem_spec(),
             pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j: (b, j)),
-            pl.BlockSpec((1, block_q), lambda b, j: (b, j)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
         interpret=interpret,
-    )(qr, kr, vr, do, lse, delta)
+    )(lens, seed_arr, qr, kr, vr, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_k=block_k, causal=causal,
-                          scale=scale, block_q=block_q),
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale, rate=rate,
+                          masked=masked),
         out_shape=[
             jax.ShapeDtypeStruct(kr.shape, k.dtype),
             jax.ShapeDtypeStruct(vr.shape, v.dtype),
         ],
-        grid=(B * H, T // block_k),
+        grid=(B * H, Tk // block_k),
         in_specs=[
-            pl.BlockSpec((1, T, D), lambda b, s: (b, 0, 0)),
+            _smem_spec(),
+            _smem_spec(),
+            pl.BlockSpec((1, Tq, D), lambda b, s: (b, 0, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
-            pl.BlockSpec((1, T, D), lambda b, s: (b, 0, 0)),
-            pl.BlockSpec((1, T), lambda b, s: (b, 0)),
-            pl.BlockSpec((1, T), lambda b, s: (b, 0)),
+            pl.BlockSpec((1, Tq, D), lambda b, s: (b, 0, 0)),
+            pl.BlockSpec((1, Tq, _LSE_LANES), lambda b, s: (b, 0, 0)),
+            pl.BlockSpec((1, Tq, _LSE_LANES), lambda b, s: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
         ],
         interpret=interpret,
-    )(qr, kr, vr, do, lse, delta)
+    )(lens, seed_arr, qr, kr, vr, do, lse, delta)
 
-    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
-            dv.reshape(B, H, T, D))
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
 
 
-def _xla_attention(q, k, v, causal, scale):
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
+                   rng_key=None):
+    """Unfused reference composition (and the off-TPU fallback). With
+    dropout it draws its own jax.random mask — statistically, not
+    bitwise, equivalent to the kernel's hash RNG."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    Tq, Tk = q.shape[2], k.shape[2]
     if causal:
-        T = q.shape[2]
-        mask = jnp.tril(jnp.ones((T, T), bool))
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool))
         s = jnp.where(mask[None, None], s, _NEG)
+    if seq_lens is not None:
+        k_pos = jnp.arange(Tk)[None, None, None, :]
+        valid = k_pos < jnp.maximum(seq_lens.astype(jnp.int32), 1).reshape(
+            -1, 1, 1, 1)
+        s = jnp.where(valid, s, _NEG)
     w = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    if rate > 0.0:
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        keep = jax.random.bernoulli(rng_key, 1.0 - rate, w.shape)
+        w = jnp.where(keep, w / (1.0 - rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(
+        q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=False):
-    """[B, H, T, D] attention via the Pallas kernel; T must divide by the
-    block sizes (clamped to T)."""
+def _check_tileable(q, k, block_q, block_k):
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    if Tq % bq or Tk % bk:
+        raise ValueError(
+            "flash_attention needs Tq/Tk divisible by the (clamped) block "
+            "sizes, got Tq=%d Tk=%d blocks=(%d, %d); use fused_attention "
+            "for automatic XLA fallback on odd shapes" % (Tq, Tk, bq, bk))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def flash_attention(q, k, v, seq_lens=None, seed=0, causal=False, scale=None,
+                    rate=0.0, block_q=128, block_k=128, interpret=False):
+    """[B, H, T, D] attention via the Pallas kernels.
+
+    ``seq_lens`` ([B] int) masks keys at positions >= len (padding mask);
+    lengths are clamped to >= 1, so a fully-empty sequence attends to key
+    position 0 rather than producing NaNs — callers with genuinely empty
+    rows must mask the corresponding outputs/loss themselves. ``rate`` is
+    in-kernel attention-weight dropout reproduced exactly in the backward
+    kernels from ``seed``. Tq/Tk must divide by the (clamped) block sizes
+    (ValueError otherwise — ``fused_attention`` handles the fallback).
+    """
+    _check_tileable(q, k, block_q, block_k)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+    out, _ = _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate,
+                            block_q, block_k, interpret)
     return out
 
 
@@ -288,27 +436,36 @@ def _use_xla_bwd():
     return os.environ.get("PADDLE_TPU_FLASH_BWD", "") == "xla"
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fa_fwd(q, k, v, seq_lens, seed, causal, scale, rate, block_q, block_k,
+            interpret):
+    _check_tileable(q, k, block_q, block_k)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
-    return out, (q, k, v, out, lse)
+    out, lse = _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate,
+                              block_q, block_k, interpret)
+    return out, (q, k, v, out, lse, seq_lens, seed)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+def _fa_bwd(causal, scale, rate, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, seq_lens, seed = res
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
-    T = q.shape[2]
-    bq, bk = min(block_q, T), min(block_k, T)
-    if _use_xla_bwd() or T % bq or T % bk:
-        # fallback: recompute attention in XLA (O(T^2) intermediates but
-        # always correct for odd shapes)
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    if _use_xla_bwd():
+        if rate > 0.0:
+            raise RuntimeError(
+                "PADDLE_TPU_FLASH_BWD=xla cannot be combined with in-kernel "
+                "attention dropout: XLA cannot reproduce the kernel's hash "
+                "mask. Unset the flag or set dropout_rate=0.")
+        # escape hatch: recompute attention in XLA (O(T^2) intermediates)
+        # for chips where the backward kernels fail to lower
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, scale_),
+            lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, scale_,
+                                              seq_lens),
             q, k, v)
-        return vjp(g)
-    return _flash_backward(q, k, v, out, lse, g, causal, scale_, bq, bk,
-                           interpret)
+        return (*vjp(g), None, None)
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, seq_lens, seed,
+                                 causal, scale_, rate, bq, bk, interpret)
+    return dq, dk, dv, None, None
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -321,15 +478,20 @@ def _on_tpu():
         return False
 
 
-def fused_attention(q, k, v, causal=False, scale=None, force_pallas=None):
-    """Pallas flash attention on TPU; plain-XLA composition elsewhere.
-    ``force_pallas=True`` runs the kernel in interpreter mode off-TPU
-    (tests)."""
+def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
+                    dropout_rate=0.0, seed=0, force_pallas=None):
+    """Pallas flash attention on TPU; plain-XLA composition elsewhere
+    (odd shapes, non-TPU backends). ``seq_lens`` lengths are clamped to
+    >= 1 (see flash_attention). ``force_pallas=True`` runs the kernel in
+    interpreter mode off-TPU (tests)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    T = q.shape[2]
+    Tq, Tk = q.shape[2], k.shape[2]
+    tileable = Tq % min(128, Tq) == 0 and Tk % min(128, Tk) == 0
     use_pallas = force_pallas if force_pallas is not None else (
-        _HAS_PLTPU and _on_tpu() and T % 128 == 0)
+        _HAS_PLTPU and _on_tpu() and tileable)
     if use_pallas:
-        return flash_attention(q, k, v, causal, scale,
-                               interpret=not _on_tpu())
-    return _xla_attention(q, k, v, causal, scale)
+        return flash_attention(q, k, v, seq_lens, seed, causal, scale,
+                               dropout_rate, interpret=not _on_tpu())
+    key = jax.random.PRNGKey(seed) if dropout_rate > 0.0 else None
+    return _xla_attention(q, k, v, causal, scale, seq_lens, dropout_rate,
+                          key)

@@ -221,18 +221,31 @@ def batch_norm(ctx, ins, attrs):
     }
 
 
-@register_op("fused_attention")
+@register_op("fused_attention", needs_rng=True, no_grad_inputs=("SeqLens",))
 def fused_attention_op(ctx, ins, attrs):
     """Whole-attention fusion: Pallas flash kernel on TPU, XLA composition
-    elsewhere (inputs Q/K/V are [B, H, T, D])."""
+    elsewhere (inputs Q/K/V are [B, H, T, D]; optional SeqLens [B] masks
+    keys past each sequence's length — the TPU-native form of the
+    reference's additive [B, H, T, T] padding masks). ``dropout_rate``
+    is attention-weight dropout executed inside the kernel (counter-based
+    hash RNG, reproduced exactly by the backward kernels)."""
     from paddle_tpu.kernels import fused_attention as _fa
 
     q = single(ins, "Q")
     k = single(ins, "K")
     v = single(ins, "V")
+    lens = single(ins, "SeqLens") if ins.get("SeqLens") else None
+    rate = float(attrs.get("dropout_rate", 0.0))
+    if attrs.get("is_test", False) or ctx.is_test:
+        rate = 0.0
+    if rate > 0.0:
+        seed = jax.random.randint(ctx.rng(), (), 0, jnp.iinfo(jnp.int32).max)
+    else:
+        seed = 0
     out = _fa(q, k, v,
               causal=bool(attrs.get("causal", False)),
-              scale=attrs.get("scale", None))
+              scale=attrs.get("scale", None),
+              seq_lens=lens, dropout_rate=rate, seed=seed)
     return {"Out": [out]}
 
 

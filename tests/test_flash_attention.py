@@ -1,5 +1,6 @@
 """Flash-attention Pallas kernel tests, run in interpreter mode on the CPU
-backend (the compiled path differs only in lowering, not math)."""
+backend (the compiled path differs only in lowering, not math; the real-chip
+lowering is exercised by bench.py's flash section)."""
 
 import numpy as np
 import pytest
@@ -15,13 +16,19 @@ def _rand(shape, seed=0):
     return np.random.RandomState(seed).randn(*shape).astype(np.float32)
 
 
+def _flash(q, k, v, causal=False, seq_lens=None, rate=0.0, seed=0,
+           block_q=128, block_k=128):
+    return flash_attention(q, k, v, seq_lens, seed, causal, None, rate,
+                           block_q, block_k, True)
+
+
 class TestFlashAttentionKernel:
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("T,block", [(128, 128), (256, 128), (64, 32)])
     def test_forward_matches_xla(self, causal, T, block):
         B, H, D = 2, 2, 32
         q, k, v = (_rand((B, H, T, D), s) for s in (0, 1, 2))
-        got = flash_attention(q, k, v, causal, None, block, block, True)
+        got = _flash(q, k, v, causal, block_q=block, block_k=block)
         want = _xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
                               causal, D ** -0.5)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -33,7 +40,7 @@ class TestFlashAttentionKernel:
 
         def loss_flash(q, k, v):
             return jnp.sum(
-                flash_attention(q, k, v, True, None, 32, 32, True) ** 2)
+                _flash(q, k, v, True, block_q=32, block_k=32) ** 2)
 
         def loss_ref(q, k, v):
             return jnp.sum(_xla_attention(q, k, v, True, D ** -0.5) ** 2)
@@ -43,6 +50,103 @@ class TestFlashAttentionKernel:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4, rtol=2e-3)
+
+
+class TestSeqLensMask:
+    """Key-padding masks passed as per-sequence lengths in SMEM."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_masked_xla(self, causal):
+        B, H, T, D = 3, 2, 128, 32
+        q, k, v = (_rand((B, H, T, D), s) for s in (0, 1, 2))
+        lens = jnp.array([128, 70, 13], jnp.int32)
+        got = _flash(q, k, v, causal, seq_lens=lens, block_q=64, block_k=64)
+        want = _xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal, D ** -0.5, seq_lens=lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_masked_xla(self, causal):
+        B, H, T, D = 2, 2, 128, 16
+        q, k, v = (_rand((B, H, T, D), s) for s in (3, 4, 5))
+        lens = jnp.array([90, 128], jnp.int32)
+        g = jnp.asarray(_rand((B, H, T, D), 6))
+
+        _, vjp_f = jax.vjp(
+            lambda a, b, c: _flash(a, b, c, causal, seq_lens=lens,
+                                   block_q=64, block_k=64),
+            *map(jnp.asarray, (q, k, v)))
+        _, vjp_r = jax.vjp(
+            lambda a, b, c: _xla_attention(a, b, c, causal, D ** -0.5,
+                                           seq_lens=lens),
+            *map(jnp.asarray, (q, k, v)))
+        for got, want, name in zip(vjp_f(g), vjp_r(g), ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=5e-4, rtol=5e-3,
+                err_msg=name)
+
+    def test_cross_attention_tq_ne_tk(self):
+        B, H, Tq, Tk, D = 2, 2, 64, 128, 16
+        q = _rand((B, H, Tq, D), 0)
+        k, v = _rand((B, H, Tk, D), 1), _rand((B, H, Tk, D), 2)
+        lens = jnp.array([128, 40], jnp.int32)
+        got = _flash(q, k, v, False, seq_lens=lens, block_q=32, block_k=64)
+        want = _xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              False, D ** -0.5, seq_lens=lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-4)
+
+
+class TestInKernelDropout:
+    """Counter-based hash-RNG attention dropout: deterministic given the
+    seed, reproduced exactly by the backward kernels."""
+
+    def test_statistics_and_determinism(self):
+        B, H, T, D = 2, 2, 128, 16
+        q, k, v = (_rand((B, H, T, D), s) for s in (0, 1, 2))
+        rate = 0.4
+        out1 = _flash(q, k, v, rate=rate, seed=7, block_q=64, block_k=64)
+        out2 = _flash(q, k, v, rate=rate, seed=7, block_q=32, block_k=32)
+        # same seed -> identical output even under a different tiling
+        # (the mask is a function of global coordinates, not block ids)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   atol=1e-5, rtol=1e-4)
+        out3 = _flash(q, k, v, rate=rate, seed=8, block_q=64, block_k=64)
+        assert np.abs(np.asarray(out1) - np.asarray(out3)).max() > 1e-3
+        # expectation preserved (upscale_in_train): mean close to undropped
+        base = _flash(q, k, v, block_q=64, block_k=64)
+        assert np.abs(np.asarray(out1).mean()
+                      - np.asarray(base).mean()) < 0.05
+
+    def test_dropout_gradients_finite_differences(self):
+        """The analytic grads (backward kernels regenerating the hash mask)
+        must match finite differences of the same stochastic-but-
+        deterministic forward."""
+        B, H, T, D = 1, 1, 32, 8
+        q, k, v = (jnp.asarray(_rand((B, H, T, D), s) * 0.5)
+                   for s in (3, 4, 5))
+        rate, seed = 0.3, 11
+
+        def loss(q_, k_, v_):
+            return jnp.sum(
+                _flash(q_, k_, v_, rate=rate, seed=seed, block_q=16,
+                       block_k=16) ** 2)
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        eps = 1e-3
+        rng = np.random.RandomState(0)
+        for arr, g, name in ((q, dq, "dq"), (k, dk, "dk"), (v, dv, "dv")):
+            for _ in range(5):
+                idx = tuple(rng.randint(0, s) for s in arr.shape)
+                d = np.zeros(arr.shape, np.float32)
+                d[idx] = eps
+                f_p = loss(*[a + d if a is arr else a for a in (q, k, v)])
+                f_m = loss(*[a - d if a is arr else a for a in (q, k, v)])
+                fd = (float(f_p) - float(f_m)) / (2 * eps)
+                np.testing.assert_allclose(
+                    float(g[idx]), fd, atol=5e-2, rtol=5e-2,
+                    err_msg="%s %s" % (name, idx))
 
 
 class TestFusedAttentionOp:
@@ -74,6 +178,39 @@ class TestFusedAttentionOp:
         np.testing.assert_allclose(got, np.asarray(want), atol=2e-5,
                                    rtol=2e-4)
 
+    def test_program_op_with_seq_lens(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.framework import Program, program_guard
+        from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+        B, H, T, D = 2, 2, 16, 8
+        q, k, v = (_rand((B, H, T, D), s) for s in (6, 7, 8))
+        lens = np.array([10, 16], np.int64)
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            block = main.global_block()
+            for n, arr in (("q", q), ("k", k), ("v", v)):
+                block.create_var(name=n, shape=list(arr.shape),
+                                 dtype=convert_np_dtype_to_dtype_(arr.dtype))
+            block.create_var(name="lens", shape=[B], dtype="int64")
+            block.create_var(name="out", shape=None, dtype="float32")
+            block.append_op(
+                type="fused_attention",
+                inputs={"Q": ["q"], "K": ["k"], "V": ["v"],
+                        "SeqLens": ["lens"]},
+                outputs={"Out": ["out"]},
+                attrs={"causal": False},
+            )
+            exe = fluid.Executor()
+            (got,) = exe.run(main, feed={"q": q, "k": k, "v": v,
+                                         "lens": lens},
+                             fetch_list=["out"])
+        want = _xla_attention(jnp.asarray(q), jnp.asarray(k),
+                              jnp.asarray(v), False, D ** -0.5,
+                              seq_lens=jnp.asarray(lens))
+        np.testing.assert_allclose(got, np.asarray(want), atol=2e-5,
+                                   rtol=2e-4)
+
 
 class TestFlashBackwardKernel:
     """The Pallas dQ/dKdV kernels (FlashAttention-2 decomposition) vs XLA
@@ -88,7 +225,7 @@ class TestFlashBackwardKernel:
         g = _rand((B, H, T, D), 10)
 
         def flash(q_, k_, v_):
-            return flash_attention(q_, k_, v_, causal, None, bq, bk, True)
+            return _flash(q_, k_, v_, causal, block_q=bq, block_k=bk)
 
         def ref(q_, k_, v_):
             return _xla_attention(q_, k_, v_, causal, D ** -0.5)
@@ -109,8 +246,8 @@ class TestFlashBackwardKernel:
 
         def loss(q_, k_, v_):
             return jnp.sum(
-                flash_attention(q_, k_, v_, True, None, 64, 64,
-                                True).astype(jnp.float32) ** 2)
+                _flash(q_, k_, v_, True, block_q=64,
+                       block_k=64).astype(jnp.float32) ** 2)
 
         grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
         ref_grads = jax.grad(
@@ -124,15 +261,19 @@ class TestFlashBackwardKernel:
             np.testing.assert_allclose(
                 g32, np.asarray(want, np.float32), atol=0.15, rtol=0.15)
 
-    def test_xla_fallback_on_odd_shapes(self):
-        # T not divisible by the clamped blocks -> fallback path, still
-        # correct
+    def test_odd_shapes_raise_and_fused_falls_back(self):
+        # T not divisible by the clamped blocks: the raw kernel refuses
+        # (a truncated grid would silently skip rows); the fused_attention
+        # dispatcher falls back to the XLA composition instead.
+        from paddle_tpu.kernels.flash_attention import fused_attention
+
         B, H, T, D = 1, 1, 48, 16
         q, k, v = (jnp.asarray(_rand((B, H, T, D), s)) for s in (4, 5, 6))
+        with pytest.raises(ValueError, match="divisible"):
+            _flash(q, k, v, False, block_q=32, block_k=32)
 
         def loss(q_):
-            return jnp.sum(flash_attention(q_, k, v, False, None, 32, 32,
-                                           True))
+            return jnp.sum(fused_attention(q_, k, v, force_pallas=False))
 
         g = jax.grad(loss)(q)
         ref = jax.grad(lambda q_: jnp.sum(
