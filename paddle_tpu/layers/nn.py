@@ -2348,3 +2348,30 @@ def similarity_focus(input, axis, indexes, name=None):
                      outputs={"Out": [out]},
                      attrs={"axis": axis, "indexes": list(indexes)})
     return out
+
+
+def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
+                    dropout_rate=0.0, name=None):
+    """Whole-attention fusion over [B, H, T, D] inputs: the Pallas
+    flash-attention kernel on TPU, plain-XLA composition elsewhere.
+
+    Beyond-reference TPU-first layer (the reference composes
+    matmul+softmax+dropout; its fused-op strategy lives in
+    paddle/fluid/operators/fused/). ``seq_lens`` ([B] or [B, 1] int)
+    replaces the reference's additive [B, H, T, T] padding masks with
+    per-sequence valid lengths; ``causal`` is a static flag;
+    ``dropout_rate`` is attention-weight dropout executed inside the
+    kernel. Not part of the fluid.layers golden surface (kept out of
+    __all__); models reach it via this module directly.
+    """
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if seq_lens is not None:
+        inputs["SeqLens"] = [seq_lens]
+    attrs = {"causal": bool(causal), "dropout_rate": float(dropout_rate)}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(type="fused_attention", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out

@@ -11,7 +11,7 @@ from paddle_tpu.models.transformer import (
 )
 
 
-def bert_encoder(src_ids, pos_ids, sent_ids, attn_mask, vocab_size,
+def bert_encoder(src_ids, pos_ids, sent_ids, seq_lens, vocab_size,
                  max_position=512, type_vocab_size=2, d_model=768,
                  n_layers=12, n_heads=12, d_inner=3072, dropout=0.1,
                  is_train=True):
@@ -35,7 +35,7 @@ def bert_encoder(src_ids, pos_ids, sent_ids, attn_mask, vocab_size,
     h = emb
     for _ in range(n_layers):
         attn = multi_head_attention(h, h, h, d_model, n_heads, dropout,
-                                    mask=attn_mask, is_train=is_train)
+                                    seq_lens=seq_lens, is_train=is_train)
         h = pre_post_process(h, attn, dropout, is_train)
         f = ffn(h, d_model, d_inner, is_train, act="gelu")
         h = pre_post_process(h, f, dropout, is_train)
@@ -91,16 +91,15 @@ def get_model(batch_size=8, seq_len=128, vocab_size=30522, d_model=768,
                                 dtype="int64")
         sent = fluid.layers.data(name="sent_ids", shape=[seq_len],
                                  dtype="int64")
-        attn_mask = fluid.layers.data(
-            name="attn_mask", shape=[n_heads, seq_len, seq_len],
-            dtype="float32")
+        seq_lens = fluid.layers.data(name="seq_lens", shape=[1],
+                                     dtype="int64")
         mask_label = fluid.layers.data(name="mask_label", shape=[seq_len],
                                        dtype="int64")
         mask_weight = fluid.layers.data(name="mask_weight", shape=[seq_len],
                                         dtype="float32")
         ns_label = fluid.layers.data(name="ns_label", shape=[1],
                                      dtype="int64")
-        enc = bert_encoder(src, pos, sent, attn_mask, vocab_size,
+        enc = bert_encoder(src, pos, sent, seq_lens, vocab_size,
                            max_position=max_position, d_model=d_model,
                            n_layers=n_layers, n_heads=n_heads,
                            d_inner=d_inner, dropout=dropout,
@@ -111,24 +110,30 @@ def get_model(batch_size=8, seq_len=128, vocab_size=30522, d_model=768,
         if is_train:
             fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
     feeds = {"src_ids": src, "pos_ids": pos, "sent_ids": sent,
-             "attn_mask": attn_mask, "mask_label": mask_label,
+             "seq_lens": seq_lens, "mask_label": mask_label,
              "mask_weight": mask_weight, "ns_label": ns_label}
     return main, startup, {"feeds": feeds, "loss": loss,
                            "mlm_loss": mlm_loss, "ns_loss": ns_loss,
                            "enc_out": enc}
 
 
-def make_fake_batch(batch_size, seq_len, vocab_size, n_heads, mask_frac=0.15,
-                    rng=None):
+def make_fake_batch(batch_size, seq_len, vocab_size, n_heads=None,
+                    mask_frac=0.15, rng=None, varlen=False):
+    """``varlen=True`` draws ragged lengths to exercise the key-padding
+    masks (otherwise full-length, the bench configuration)."""
     rng = rng or np.random.RandomState(0)
     src = rng.randint(0, vocab_size, (batch_size, seq_len)).astype(np.int64)
     pos = np.tile(np.arange(seq_len, dtype=np.int64), (batch_size, 1))
     sent = np.zeros((batch_size, seq_len), np.int64)
-    attn_mask = np.zeros((batch_size, n_heads, seq_len, seq_len), np.float32)
+    if varlen:
+        lens = rng.randint(max(seq_len // 2, 1), seq_len + 1,
+                           (batch_size, 1)).astype(np.int64)
+    else:
+        lens = np.full((batch_size, 1), seq_len, np.int64)
     mask_label = src.copy()
     mask_weight = (rng.rand(batch_size, seq_len) < mask_frac).astype(
         np.float32)
     ns_label = rng.randint(0, 2, (batch_size, 1)).astype(np.int64)
     return {"src_ids": src, "pos_ids": pos, "sent_ids": sent,
-            "attn_mask": attn_mask, "mask_label": mask_label,
+            "seq_lens": lens, "mask_label": mask_label,
             "mask_weight": mask_weight, "ns_label": ns_label}

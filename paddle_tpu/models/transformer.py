@@ -4,15 +4,19 @@ attention; training is teacher-forced over padded batches with masks — the
 TPU-native stand-in for the reference's LoDTensor padding-free batching
 (SURVEY.md §5 long-sequence story).
 
-The attention core routes through ``paddle_tpu.parallel.fused_attention``
-when available (Pallas flash-attention on TPU) and falls back to plain
-layer composition otherwise.
+The attention core emits the ``fused_attention`` op (Pallas
+flash-attention kernels on TPU, XLA composition elsewhere —
+paddle_tpu/kernels/flash_attention.py): padding is expressed as
+per-sequence lengths, causality as a static flag, and attention-weight
+dropout runs inside the kernel. A dense additive-mask path remains for
+masks that aren't (length, causal)-representable.
 """
 
 import numpy as np
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.initializer import NumpyArrayInitializer
+from paddle_tpu.layers.nn import fused_attention as _fused_attention_layer
 
 
 def positional_encoding_table(max_len, d_model):
@@ -26,9 +30,15 @@ def positional_encoding_table(max_len, d_model):
 
 
 def multi_head_attention(q_in, k_in, v_in, d_model, n_heads, dropout_rate,
-                         mask=None, is_train=True, name=None):
+                         mask=None, seq_lens=None, causal=False,
+                         is_train=True, name=None):
     """Scaled dot-product attention with head split/merge
-    (reference: dist_transformer.py multi_head_attention)."""
+    (reference: dist_transformer.py multi_head_attention).
+
+    With ``mask=None`` the core is a single ``fused_attention`` op
+    (Pallas flash kernels on TPU): key padding via ``seq_lens``, causal
+    via the flag, attention dropout in-kernel. A dense additive ``mask``
+    forces the unfused composition."""
     d_head = d_model // n_heads
     q = fluid.layers.fc(input=q_in, size=d_model, num_flatten_dims=2,
                         bias_attr=False)
@@ -42,16 +52,21 @@ def multi_head_attention(q_in, k_in, v_in, d_model, n_heads, dropout_rate,
         return fluid.layers.transpose(x, perm=[0, 2, 1, 3])  # [B,H,T,dh]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scores = fluid.layers.matmul(q, k, transpose_y=True,
-                                 alpha=d_head ** -0.5)
-    if mask is not None:
+    if mask is None:
+        ctx = _fused_attention_layer(
+            q, k, v, causal=causal, scale=d_head ** -0.5,
+            seq_lens=seq_lens,
+            dropout_rate=dropout_rate if is_train else 0.0)
+    else:
+        scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                     alpha=d_head ** -0.5)
         scores = fluid.layers.elementwise_add(scores, mask)
-    weights = fluid.layers.softmax(scores)
-    if dropout_rate > 0:
-        weights = fluid.layers.dropout(
-            weights, dropout_prob=dropout_rate, is_test=not is_train,
-            dropout_implementation="upscale_in_train")
-    ctx = fluid.layers.matmul(weights, v)  # [B,H,T,dh]
+        weights = fluid.layers.softmax(scores)
+        if dropout_rate > 0:
+            weights = fluid.layers.dropout(
+                weights, dropout_prob=dropout_rate, is_test=not is_train,
+                dropout_implementation="upscale_in_train")
+        ctx = fluid.layers.matmul(weights, v)  # [B,H,T,dh]
     ctx = fluid.layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = fluid.layers.reshape(ctx, shape=[0, 0, d_model])
     return fluid.layers.fc(input=ctx, size=d_model, num_flatten_dims=2,
@@ -74,21 +89,23 @@ def pre_post_process(prev, out, dropout_rate, is_train):
     return fluid.layers.layer_norm(out, begin_norm_axis=2)
 
 
-def encoder_layer(x, d_model, n_heads, d_inner, dropout, mask, is_train):
+def encoder_layer(x, d_model, n_heads, d_inner, dropout, src_lens, is_train):
     attn = multi_head_attention(x, x, x, d_model, n_heads, dropout,
-                                mask=mask, is_train=is_train)
+                                seq_lens=src_lens, is_train=is_train)
     x = pre_post_process(x, attn, dropout, is_train)
     f = ffn(x, d_model, d_inner, is_train)
     return pre_post_process(x, f, dropout, is_train)
 
 
 def decoder_layer(x, enc_out, d_model, n_heads, d_inner, dropout,
-                  self_mask, cross_mask, is_train):
+                  trg_lens, src_lens, is_train):
     self_attn = multi_head_attention(x, x, x, d_model, n_heads, dropout,
-                                     mask=self_mask, is_train=is_train)
+                                     seq_lens=trg_lens, causal=True,
+                                     is_train=is_train)
     x = pre_post_process(x, self_attn, dropout, is_train)
     cross = multi_head_attention(x, enc_out, enc_out, d_model, n_heads,
-                                 dropout, mask=cross_mask, is_train=is_train)
+                                 dropout, seq_lens=src_lens,
+                                 is_train=is_train)
     x = pre_post_process(x, cross, dropout, is_train)
     f = ffn(x, d_model, d_inner, is_train)
     return pre_post_process(x, f, dropout, is_train)
@@ -110,19 +127,19 @@ def embed(ids, vocab_size, d_model, max_len, pos_ids, scope_name):
 
 
 def build_transformer(src_ids, src_pos, trg_ids, trg_pos, label,
-                      src_pad_mask, trg_self_mask, cross_mask,
+                      src_lens, trg_lens,
                       vocab_size, d_model=256, n_heads=8, d_inner=1024,
                       n_layers=4, dropout=0.1, max_len=256, is_train=True,
                       label_smooth_eps=0.1):
     enc = embed(src_ids, vocab_size, d_model, max_len, src_pos, "src")
     for _ in range(n_layers):
         enc = encoder_layer(enc, d_model, n_heads, d_inner, dropout,
-                            src_pad_mask, is_train)
+                            src_lens, is_train)
 
     dec = embed(trg_ids, vocab_size, d_model, max_len, trg_pos, "trg")
     for _ in range(n_layers):
         dec = decoder_layer(dec, enc, d_model, n_heads, d_inner, dropout,
-                            trg_self_mask, cross_mask, is_train)
+                            trg_lens, src_lens, is_train)
 
     logits = fluid.layers.fc(input=dec, size=vocab_size, num_flatten_dims=2,
                              act=None)
@@ -144,8 +161,9 @@ def build_transformer(src_ids, src_pos, trg_ids, trg_pos, label,
 def get_model(batch_size=8, seq_len=16, vocab_size=1000, d_model=64,
               n_heads=4, d_inner=128, n_layers=2, dropout=0.1, lr=1e-3,
               is_train=True, label_smooth_eps=0.1):
-    """Feeds: src/trg token ids + position ids + additive attention masks
-    (0 keep / -1e9 drop), all padded to seq_len."""
+    """Feeds: src/trg token ids + position ids + per-sequence valid
+    lengths (key-padding masks, TPU-first: no dense [B,H,T,T] mask
+    tensors; the decoder's causal mask is structural)."""
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
@@ -157,41 +175,38 @@ def get_model(batch_size=8, seq_len=16, vocab_size=1000, d_model=64,
                                     dtype="int64")
         label = fluid.layers.data(name="label", shape=[seq_len],
                                   dtype="int64")
-        src_mask = fluid.layers.data(
-            name="src_mask", shape=[n_heads, seq_len, seq_len],
-            dtype="float32")
-        trg_mask = fluid.layers.data(
-            name="trg_mask", shape=[n_heads, seq_len, seq_len],
-            dtype="float32")
-        cross_mask = fluid.layers.data(
-            name="cross_mask", shape=[n_heads, seq_len, seq_len],
-            dtype="float32")
+        src_lens = fluid.layers.data(name="src_lens", shape=[1],
+                                     dtype="int64")
+        trg_lens = fluid.layers.data(name="trg_lens", shape=[1],
+                                     dtype="int64")
         loss, logits = build_transformer(
-            src, src_pos, trg, trg_pos, label, src_mask, trg_mask,
-            cross_mask, vocab_size, d_model, n_heads, d_inner, n_layers,
+            src, src_pos, trg, trg_pos, label, src_lens, trg_lens,
+            vocab_size, d_model, n_heads, d_inner, n_layers,
             dropout, max_len=max(seq_len, 256), is_train=is_train,
             label_smooth_eps=label_smooth_eps)
         if is_train:
             fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
     feeds = {"src": src, "src_pos": src_pos, "trg": trg, "trg_pos": trg_pos,
-             "label": label, "src_mask": src_mask, "trg_mask": trg_mask,
-             "cross_mask": cross_mask}
+             "label": label, "src_lens": src_lens, "trg_lens": trg_lens}
     return main, startup, {"feeds": feeds, "loss": loss, "logits": logits}
 
 
-def make_fake_batch(batch_size, seq_len, vocab_size, n_heads, rng=None):
-    """Synthetic copy-task batch: target = source shifted (learnable)."""
+def make_fake_batch(batch_size, seq_len, vocab_size, n_heads=None, rng=None,
+                    varlen=False):
+    """Synthetic copy-task batch: target = source shifted (learnable).
+    ``varlen=True`` draws ragged lengths to exercise the padding masks."""
     rng = rng or np.random.RandomState(0)
     src = rng.randint(1, vocab_size, (batch_size, seq_len)).astype(np.int64)
     trg = np.concatenate(
         [np.ones((batch_size, 1), np.int64), src[:, :-1]], axis=1)
     label = src.copy()
     pos = np.tile(np.arange(seq_len, dtype=np.int64), (batch_size, 1))
-    zero_mask = np.zeros((batch_size, n_heads, seq_len, seq_len), np.float32)
-    causal = np.triu(np.full((seq_len, seq_len), -1e9, np.float32), k=1)
-    trg_mask = np.tile(causal, (batch_size, n_heads, 1, 1))
+    if varlen:
+        lens = rng.randint(max(seq_len // 2, 1), seq_len + 1,
+                           (batch_size, 1)).astype(np.int64)
+    else:
+        lens = np.full((batch_size, 1), seq_len, np.int64)
     return {
         "src": src, "src_pos": pos, "trg": trg, "trg_pos": pos,
-        "label": label, "src_mask": zero_mask, "trg_mask": trg_mask,
-        "cross_mask": zero_mask,
+        "label": label, "src_lens": lens, "trg_lens": lens.copy(),
     }

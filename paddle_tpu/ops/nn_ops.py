@@ -231,10 +231,10 @@ def fused_attention_op(ctx, ins, attrs):
     hash RNG, reproduced exactly by the backward kernels)."""
     from paddle_tpu.kernels import fused_attention as _fa
 
-    q = single(ins, "Q")
-    k = single(ins, "K")
-    v = single(ins, "V")
+    q, k, v = amp_cast(single(ins, "Q"), single(ins, "K"), single(ins, "V"))
     lens = single(ins, "SeqLens") if ins.get("SeqLens") else None
+    if lens is not None:
+        lens = lens.reshape(-1)  # accept [B] or [B, 1] feeds
     rate = float(attrs.get("dropout_rate", 0.0))
     if attrs.get("is_test", False) or ctx.is_test:
         rate = 0.0
