@@ -67,19 +67,13 @@ def _keep_mask(seed, b, q_pos, k_pos, t_k, rate):
     coordinate: murmur3 finalizer bits -> uniform [0,1) -> >= rate.
     Counter-based, so the dQ and dK/dV kernels reproduce the forward's
     mask exactly regardless of their different iteration orders."""
+    from paddle_tpu.ops.common import hash_mix_bits, keep_threshold
+
     idx = (q_pos * t_k + k_pos).astype(jnp.uint32)
-    h = (idx ^ (seed.astype(jnp.uint32)
-                + jnp.uint32(0x9E3779B9) * (b + 1).astype(jnp.uint32)))
-    # two-round xorshift-multiply mix: enough avalanche for a dropout
-    # mask at a fraction of murmur3's VPU cost (this runs per element in
-    # all three kernels)
-    h = h * jnp.uint32(0x85EBCA6B)
-    h = h ^ (h >> 13)
-    h = h * jnp.uint32(0xC2B2AE35)
-    h = h ^ (h >> 16)
-    # integer threshold compare — no int->float conversion in the hot loop
-    thresh = jnp.uint32(int(rate * float(1 << 24)))
-    return (h >> 8) >= thresh
+    h = hash_mix_bits(idx ^ (seed.astype(jnp.uint32)
+                             + jnp.uint32(0x9E3779B9)
+                             * (b + 1).astype(jnp.uint32)))
+    return (h >> 8) >= keep_threshold(rate)
 
 
 def _nk_limit(nk, causal_hi, length, block_k, masked, causal):
@@ -398,9 +392,11 @@ def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
         s = jnp.where(valid, s, _NEG)
     w = jax.nn.softmax(s, axis=-1)
     if rate > 0.0:
+        from paddle_tpu.ops.common import hash_keep_mask
+
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
-        keep = jax.random.bernoulli(rng_key, 1.0 - rate, w.shape)
+        keep = hash_keep_mask(rng_key, w.shape, rate)
         w = jnp.where(keep, w / (1.0 - rate), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(
         q.dtype)

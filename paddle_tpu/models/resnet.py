@@ -14,6 +14,12 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
 
 
 def shortcut(input, ch_in, ch_out, stride, is_train=True):
+    # derive the true input width from the tensor, like the reference
+    # (benchmark/fluid/models/resnet.py:112 shortcut) — the bookkeeping
+    # ch_in is wrong for bottleneck loop blocks (input is ch_out*4 wide),
+    # and a spurious projection conv on every identity shortcut both
+    # deviates from ResNet-50 and costs ~12 extra conv+BN pairs
+    ch_in = input.shape[1]
     if ch_in != ch_out or stride != 1:
         return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
                              is_train=is_train)

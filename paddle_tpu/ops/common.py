@@ -87,3 +87,42 @@ def zero_padding_rows(flat_ids, x, padding_idx):
     if padding_idx is None or padding_idx < 0:
         return x
     return jnp.where((flat_ids == padding_idx)[..., None], 0.0, x)
+
+
+def hash_mix_bits(h):
+    """2-round xorshift-multiply finalizer: the shared statistical core of
+    every counter-based dropout mask (the generic dropout op, the XLA
+    attention fallback, and the Pallas flash kernels all call this one
+    implementation so their statistics can never silently diverge)."""
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def keep_threshold(rate):
+    """24-bit integer threshold for `mixed_bits >> 8 >= threshold` keep
+    tests (no int->float conversion in hot loops)."""
+    return jnp.uint32(int(float(rate) * (1 << 24)))
+
+
+def hash_keep_mask(key, shape, rate):
+    """Counter-based dropout keep-mask: a 2-round xorshift-multiply hash of
+    the element coordinate, seeded per op instance from ``key`` (one scalar
+    threefry draw). ~8 VPU int-ops per element vs ~100+ for a threefry mask
+    of the same size — dropout masks are pure bandwidth, they don't need a
+    cryptographic stream (the reference's curand Philox kernels make the
+    same trade, dropout_op.cu). Deterministic given the key, so the generic
+    vjp grad path regenerates the identical mask."""
+    import jax
+    import numpy as np
+
+    seed = jax.random.bits(key, dtype=jnp.uint32)  # scalar; cheap
+    rows = int(np.prod(shape[:-1], dtype=np.int64)) if len(shape) > 1 else 1
+    cols = shape[-1] if shape else 1
+    r = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
+    h = hash_mix_bits((r * jnp.uint32(cols) + c)
+                      ^ (seed * jnp.uint32(0x9E3779B9)))
+    return ((h >> 8) >= keep_threshold(rate)).reshape(shape)

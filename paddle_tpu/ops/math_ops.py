@@ -8,7 +8,7 @@ neighbors.
 
 import jax.numpy as jnp
 
-from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.registry import register_op, register_no_grad_op
 from paddle_tpu.ops.common import (
     amp_cast, bcast_y_to_x, flatten_to_2d, single,
 )
@@ -31,6 +31,89 @@ def mul(ctx, ins, attrs):
     out = jnp.matmul(x2, y2, preferred_element_type=pet)
     out_shape = x.shape[:xnc] + y.shape[ync:]
     return {"Out": [out.reshape(out_shape)]}
+
+
+@register_no_grad_op("mul_grad")
+def mul_grad(ctx, ins, attrs):
+    """Direct fc/mul gradients — two explicit transposed matmuls
+    (reference: mul_op.cc MulGradKernel), no forward primal emitted."""
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    g = single(ins, "Out@GRAD")
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    x2 = flatten_to_2d(x, xnc)
+    y2 = flatten_to_2d(y, ync)
+    x2, y2 = amp_cast(x2, y2)
+    g2 = flatten_to_2d(g, xnc).astype(x2.dtype)
+    pet = None if x2.dtype == jnp.bfloat16 else jnp.float32
+    dx = jnp.matmul(g2, y2.T, preferred_element_type=pet)
+    dy = jnp.matmul(x2.T, g2, preferred_element_type=pet)
+    return {"X@GRAD": [dx.reshape(x.shape).astype(x.dtype)],
+            "Y@GRAD": [dy.reshape(y.shape).astype(y.dtype)]}
+
+
+def _sum_to_shape(g, shape):
+    """Reduce broadcast batch dims of a matmul cotangent back to the
+    operand's shape (leading-dim broadcasting a la numpy matmul)."""
+    if g.shape == tuple(shape):
+        return g
+    extra = g.ndim - len(shape)
+    if extra > 0:
+        g = jnp.sum(g, axis=tuple(range(extra)))
+    axes = tuple(i for i, (gs, ss) in enumerate(zip(g.shape, shape))
+                 if gs != ss)
+    if axes:
+        g = jnp.sum(g, axis=axes, keepdims=True)
+    return g.reshape(shape)
+
+
+@register_no_grad_op("matmul_grad")
+def matmul_grad(ctx, ins, attrs):
+    """Direct matmul gradients for every transpose combination
+    (reference: matmul_op.cc MatMulGradKernel) — transposed products of
+    the saved operands, with broadcast batch dims summed back."""
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    g = single(ins, "Out@GRAD")
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    xa, ya = amp_cast(x, y)
+    ga = g.astype(jnp.result_type(xa, ya)) if g.dtype != xa.dtype else g
+    if alpha != 1.0:
+        ga = ga * alpha
+
+    def mm(a, b):
+        rt = jnp.result_type(a, b)
+        pet = None if rt == jnp.bfloat16 else (
+            jnp.float32 if jnp.issubdtype(rt, jnp.floating) else None)
+        return jnp.matmul(a, b, preferred_element_type=pet)
+
+    def t(a):
+        return jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+
+    # rank-1 operands degenerate to dots; lean on vjp for that rare case
+    if x.ndim == 1 or y.ndim == 1:
+        import jax
+
+        _, vjp = jax.vjp(
+            lambda xx, yy: jnp.matmul(
+                t(xx) if tx else xx, t(yy) if ty else yy), xa, ya)
+        dx, dy = vjp(ga)  # ga already carries alpha
+        return {"X@GRAD": [dx.astype(x.dtype)],
+                "Y@GRAD": [dy.astype(y.dtype)]}
+
+    if not tx and not ty:
+        dx, dy = mm(ga, t(ya)), mm(t(xa), ga)
+    elif tx and not ty:
+        dx, dy = mm(ya, t(ga)), mm(xa, ga)
+    elif not tx and ty:
+        dx, dy = mm(ga, ya), mm(t(ga), xa)
+    else:
+        dx, dy = mm(t(ya), t(ga)), mm(t(ga), t(xa))
+    return {"X@GRAD": [_sum_to_shape(dx, x.shape).astype(x.dtype)],
+            "Y@GRAD": [_sum_to_shape(dy, y.shape).astype(y.dtype)]}
 
 
 @register_op("matmul")

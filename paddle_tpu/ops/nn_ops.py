@@ -29,31 +29,59 @@ def _conv_dn(ndim):
 def conv2d(ctx, ins, attrs):
     x = single(ins, "Input")  # NCHW
     w = single(ins, "Filter")  # OIHW (I = C/groups)
-    strides = tuple(attrs.get("strides", [1, 1]))
-    paddings = attrs.get("paddings", [0, 0])
-    dilations = tuple(attrs.get("dilations", [1, 1]))
-    groups = attrs.get("groups", 1)
-    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
-    x, w = amp_cast(x, w)
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
     # Under AMP the conv runs wholly in bf16 (the MXU accumulates fp32
     # internally) and the OUTPUT STAYS bf16 — casting activations back to
     # fp32 between ops doubles HBM traffic for every elementwise/norm op
     # in between, which is the actual bottleneck (measured 21% step-time
     # cost on ResNet-50); norms/losses upcast internally where accuracy
     # needs it.
-    out = lax.conv_general_dilated(
-        x,
-        w,
-        window_strides=strides,
-        padding=pad,
-        rhs_dilation=dilations,
-        dimension_numbers=dn,
-        feature_group_count=groups,
+    x, w = amp_cast(x, w)
+    return {"Output": [_conv2d_apply(x, w, attrs)]}
+
+
+def _conv2d_apply(x, w, attrs):
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups,
         preferred_element_type=(
             jnp.float32 if x.dtype == jnp.float32 else None),
     )
-    return {"Output": [out]}
+
+
+@register_no_grad_op("conv2d_grad")
+def conv2d_grad(ctx, ins, attrs):
+    """Direct conv gradients (reference: the hand-written grad kernels of
+    conv_cudnn_op.cu.cc / conv_op.h GemmConvGradKernel). The conv is
+    bilinear, so each gradient is a ``jax.linear_transpose`` of the conv
+    with the other operand fixed — this emits ONLY the transposed
+    convolution, never a recomputed forward primal for XLA to CSE away
+    (the round-2 per-op jax.vjp residue, MFU.md)."""
+    x = single(ins, "Input")
+    w = single(ins, "Filter")
+    g = single(ins, "Output@GRAD")
+    xa, wa = amp_cast(x, w)
+    # cotangent dtype must match the forward output's (bf16 under AMP,
+    # fp32 via preferred_element_type otherwise — same rule as the fwd op)
+    out_dt = jax.eval_shape(lambda: _conv2d_apply(xa, wa, attrs)).dtype
+    g = g.astype(out_dt)
+    dx = jax.linear_transpose(lambda xx: _conv2d_apply(xx, wa, attrs), xa)(g)[0]
+    dw = jax.linear_transpose(lambda ww: _conv2d_apply(xa, ww, attrs), wa)(g)[0]
+    return {"Input@GRAD": [dx.astype(x.dtype)],
+            "Filter@GRAD": [dw.astype(w.dtype)]}
+
+
+@register_no_grad_op("depthwise_conv2d_grad")
+def depthwise_conv2d_grad(ctx, ins, attrs):
+    x = single(ins, "Input")
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return conv2d_grad(ctx, ins, attrs)
 
 
 @register_op("depthwise_conv2d")
@@ -158,6 +186,14 @@ def pool2d(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+def _bn_axes(x, layout):
+    if layout == "NCHW" and x.ndim == 4:
+        return (0, 2, 3), (1, -1, 1, 1)
+    if x.ndim == 2:
+        return (0,), (1, -1)
+    return tuple(range(x.ndim - 1)), (1,) * (x.ndim - 1) + (-1,)
+
+
 @register_op(
     "batch_norm",
     no_grad_inputs=("Mean", "Variance"),
@@ -174,15 +210,7 @@ def batch_norm(ctx, ins, attrs):
     is_test = attrs.get("is_test", False) or ctx.is_test
     use_global = attrs.get("use_global_stats", False) or is_test
 
-    if layout == "NCHW" and x.ndim == 4:
-        axes = (0, 2, 3)
-        param_shape = (1, -1, 1, 1)
-    elif x.ndim == 2:
-        axes = (0,)
-        param_shape = (1, -1)
-    else:  # NHWC
-        axes = tuple(range(x.ndim - 1))
-        param_shape = (1,) * (x.ndim - 1) + (-1,)
+    axes, param_shape = _bn_axes(x, layout)
 
     # Stats and normalization compute in fp32 even for bf16 activations
     # (bf16 mean/var over a 512×H×W batch loses precision and running
@@ -219,6 +247,60 @@ def batch_norm(ctx, ins, attrs):
         "SavedMean": [saved_mean],
         "SavedVariance": [saved_var],
     }
+
+
+@register_no_grad_op("batch_norm_grad")
+def batch_norm_grad(ctx, ins, attrs):
+    """Direct BN backward from the SAVED batch statistics (reference:
+    batch_norm_op.cc BatchNormGradKernel, which likewise consumes
+    SavedMean/SavedVariance) — the generic jax.vjp path recomputed the
+    mean/variance reductions over the full activation instead."""
+    x = single(ins, "X")
+    scale = single(ins, "Scale")
+    g = single(ins, "Y@GRAD")
+    saved_mean = single(ins, "SavedMean")
+    saved_var = single(ins, "SavedVariance")
+    eps = attrs.get("epsilon", 1e-5)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    use_global = attrs.get("use_global_stats", False) or is_test
+
+    axes, param_shape = _bn_axes(x, layout)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+
+    xc = fp32_accum(x)
+    g32 = fp32_accum(g)
+    if saved_mean is None or saved_var is None:
+        # program declared BN without its saved-stat outputs (minimal
+        # hand-built graphs): recompute the batch stats
+        if use_global:
+            saved_mean = single(ins, "Mean")
+            saved_var = single(ins, "Variance")
+        else:
+            saved_mean = jnp.mean(xc, axis=axes)
+            saved_var = (jnp.mean(jnp.square(xc), axis=axes)
+                         - jnp.square(saved_mean))
+    mean = saved_mean.reshape(param_shape)
+    inv_std = lax.rsqrt(saved_var + eps).reshape(param_shape)
+    xhat = (xc - mean) * inv_std
+
+    dbias = jnp.sum(g32, axis=axes)
+    dscale = jnp.sum(g32 * xhat, axis=axes)
+    dxhat = g32 * scale.reshape(param_shape)
+    if use_global:
+        # stats are constants: the normalization is an affine map of x
+        dx = dxhat * inv_std
+    else:
+        dx = inv_std * (
+            dxhat
+            - (dbias.reshape(param_shape) * scale.reshape(param_shape)
+               + xhat * dscale.reshape(param_shape)
+               * scale.reshape(param_shape)) / n)
+    return {"X@GRAD": [dx.astype(x.dtype)],
+            "Scale@GRAD": [dscale.astype(scale.dtype)],
+            "Bias@GRAD": [dbias.astype(scale.dtype)]}
 
 
 @register_op("fused_attention", needs_rng=True, no_grad_inputs=("SeqLens",))
@@ -285,7 +367,9 @@ def dropout(ctx, ins, attrs):
         if impl == "upscale_in_train":
             return {"Out": [x], "Mask": [jnp.ones_like(x)]}
         return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
-    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    from paddle_tpu.ops.common import hash_keep_mask
+
+    keep = hash_keep_mask(ctx.rng(), x.shape, p)
     mask = keep.astype(x.dtype)
     if impl == "upscale_in_train":
         out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)

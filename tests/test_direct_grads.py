@@ -1,0 +1,103 @@
+"""Finite-difference checks for the DIRECT grad lowerings (conv2d_grad,
+depthwise_conv2d_grad, batch_norm_grad, mul_grad, matmul_grad) that replace
+the generic jax.vjp path for the hot ops (reference: the hand-written grad
+kernels conv_cudnn_op.cu.cc, batch_norm_op.cc, mul_op.cc, matmul_op.cc)."""
+
+import numpy as np
+import pytest
+
+from tests.op_test import OpTest
+
+
+class TestConv2dGrad(OpTest):
+    @pytest.mark.parametrize("stride,pad,dilation,groups", [
+        (1, 0, 1, 1), (2, 1, 1, 1), (1, 1, 2, 1), (1, 1, 1, 2),
+    ])
+    def test_grads(self, stride, pad, dilation, groups):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 4, 8, 8).astype(np.float32)
+        w = rng.rand(6, 4 // groups, 3, 3).astype(np.float32)
+        attrs = {"strides": [stride, stride], "paddings": [pad, pad],
+                 "dilations": [dilation, dilation], "groups": groups}
+        for name in ("x", "w"):
+            self.check_grad(
+                "conv2d", {"Input": [("x", x)], "Filter": [("w", w)]},
+                name, attrs=attrs, output_slot="Output")
+
+    def test_depthwise(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 4, 6, 6).astype(np.float32)
+        w = rng.rand(4, 1, 3, 3).astype(np.float32)
+        for name in ("x", "w"):
+            self.check_grad(
+                "depthwise_conv2d",
+                {"Input": [("x", x)], "Filter": [("w", w)]},
+                name, attrs={"strides": [1, 1], "paddings": [1, 1]},
+                output_slot="Output")
+
+
+class TestBatchNormGrad(OpTest):
+    def _inputs(self, rng, C=4):
+        x = rng.rand(3, C, 5, 5).astype(np.float32) * 2 + 0.5
+        scale = rng.rand(C).astype(np.float32) + 0.5
+        bias = rng.rand(C).astype(np.float32)
+        mean = rng.rand(C).astype(np.float32)
+        var = rng.rand(C).astype(np.float32) + 0.5
+        return {"X": [("x", x)], "Scale": [("scale", scale)],
+                "Bias": [("bias", bias)], "Mean": [("mean", mean)],
+                "Variance": [("var", var)]}
+
+    @pytest.mark.parametrize("name", ["x", "scale", "bias"])
+    def test_train_mode(self, name):
+        self.check_grad(
+            "batch_norm", self._inputs(np.random.RandomState(2)), name,
+            attrs={"epsilon": 1e-5, "momentum": 0.9}, output_slot="Y")
+
+    @pytest.mark.parametrize("name", ["x", "scale", "bias"])
+    def test_use_global_stats(self, name):
+        self.check_grad(
+            "batch_norm", self._inputs(np.random.RandomState(3)), name,
+            attrs={"epsilon": 1e-5, "use_global_stats": True},
+            output_slot="Y")
+
+
+class TestMulGrad(OpTest):
+    def test_num_col_dims(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        for name in ("x", "y"):
+            self.check_grad(
+                "mul", {"X": [("x", x)], "Y": [("y", y)]}, name,
+                attrs={"x_num_col_dims": 2, "y_num_col_dims": 1})
+
+
+class TestMatmulGrad(OpTest):
+    @pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                       (False, True), (True, True)])
+    def test_transpose_combos(self, tx, ty):
+        rng = np.random.RandomState(5)
+        x = rng.rand(*((5, 4) if tx else (4, 5))).astype(np.float32)
+        y = rng.rand(*((3, 5) if ty else (5, 3))).astype(np.float32)
+        for name in ("x", "y"):
+            self.check_grad(
+                "matmul", {"X": [("x", x)], "Y": [("y", y)]}, name,
+                attrs={"transpose_X": tx, "transpose_Y": ty, "alpha": 1.7})
+
+    def test_broadcast_batch_dims(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        y = rng.rand(5, 6).astype(np.float32)
+        for name in ("x", "y"):
+            self.check_grad(
+                "matmul", {"X": [("x", x)], "Y": [("y", y)]}, name,
+                attrs={})
+
+    def test_batched_both(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        y = rng.rand(3, 5, 2).astype(np.float32)
+        for name in ("x", "y"):
+            self.check_grad(
+                "matmul", {"X": [("x", x)], "Y": [("y", y)]}, name,
+                attrs={"transpose_X": False, "transpose_Y": False})
