@@ -189,6 +189,12 @@ def _append_backward_impl(loss, block, program, parameter_list, no_grad_set,
         grad_inputs = {}
         for slot, names in op.inputs.items():
             grad_inputs[slot] = list(names)
+        # forward outputs the grad lowering consumes (saved statistics
+        # etc. — reference: grad ops declaring forward outputs as inputs,
+        # e.g. batch_norm_op.cc BatchNormGradOp's SavedMean/SavedVariance)
+        for slot in getattr(info, "grad_needs_outputs", ()):
+            if slot in op.outputs and slot not in grad_inputs:
+                grad_inputs[slot] = list(op.outputs[slot])
         for slot, gnames in out_grad_inputs.items():
             if any(g is not None for g in gnames):
                 # Keep positions aligned with the forward op's output list;

@@ -51,6 +51,10 @@ class OpInfo:
         self.no_grad_inputs = frozenset()
         # Whether lowering needs an RNG key (dropout, random init ops).
         self.needs_rng = False
+        # Forward OUTPUT slots the registered *_grad op consumes (e.g.
+        # batch_norm_grad reads SavedMean/SavedVariance); append_backward
+        # wires them into the grad op's inputs.
+        self.grad_needs_outputs = ()
         # Stateful-output slots that alias an input slot (in-place semantics
         # of the reference's optimizer ops, e.g. ParamOut aliases Param).
         self.inplace_map = {}
@@ -85,6 +89,7 @@ def register_op(
     needs_rng=False,
     inplace_map=None,
     infer_shape=None,
+    grad_needs_outputs=(),
 ):
     """Decorator registering ``fn`` as the JAX lowering of op ``type``.
 
@@ -103,6 +108,7 @@ def register_op(
         info.needs_rng = needs_rng
         info.inplace_map = dict(inplace_map or {})
         info.infer_shape = infer_shape
+        info.grad_needs_outputs = tuple(grad_needs_outputs)
         OpRegistry.register(info)
         return fn
 

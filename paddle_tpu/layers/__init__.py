@@ -24,6 +24,7 @@ from paddle_tpu.layers.io import (  # noqa: F401
     read_file,
     create_py_reader_by_data,
     random_data_generator,
+    Preprocessor,
 )
 from paddle_tpu.layers.loss import *  # noqa: F401,F403
 from paddle_tpu.layers import detection  # noqa: F401

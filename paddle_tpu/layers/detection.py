@@ -29,6 +29,8 @@ __all__ = [
     "generate_proposals",
     "rpn_target_assign",
     "generate_proposal_labels",
+    "roi_perspective_transform",
+    "generate_mask_labels",
 ]
 
 
@@ -640,3 +642,53 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
                "class_nums": class_nums or 81,
                "use_random": use_random})
     return rois, labels, tgts, in_w, out_w
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch_idx=None, name=None):
+    """Warp quadrilateral RoIs ([R, 8] clockwise quads) to a fixed
+    [transformed_height, transformed_width] grid (reference:
+    layers/detection.py:1695 + detection/roi_perspective_transform_op.cc).
+    ``rois_batch_idx`` replaces the reference's LoD."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_idx is not None:
+        inputs["RoisBatchIdx"] = [rois_batch_idx]
+    helper.append_op(
+        type="roi_perspective_transform", inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale})
+    return out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_poly_lens=None):
+    """Mask-RCNN mask targets (reference: layers/detection.py:1838 +
+    detection/generate_mask_labels_op.cc). Static-shape form: ``gt_segms``
+    is a padded [G, P, V, 2] polygon tensor with ``gt_poly_lens`` [G, P]
+    vertex counts standing in for the reference's level-3 LoD. Returns
+    (mask_rois, roi_has_mask_int32, mask_int32) with all R rows kept,
+    foreground first; padding rows carry -1."""
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = _out(helper, "float32")
+    roi_has_mask = _out(helper, "int32")
+    mask_int32 = _out(helper, "int32")
+    num = _out(helper, "int32")
+    inputs = {"ImInfo": [im_info], "GtClasses": [gt_classes],
+              "IsCrowd": [is_crowd], "GtSegms": [gt_segms],
+              "Rois": [rois], "LabelsInt32": [labels_int32]}
+    if gt_poly_lens is not None:
+        inputs["GtPolyLens"] = [gt_poly_lens]
+    helper.append_op(
+        type="generate_mask_labels", inputs=inputs,
+        outputs={"MaskRois": [mask_rois],
+                 "RoiHasMaskInt32": [roi_has_mask],
+                 "MaskInt32": [mask_int32],
+                 "MaskRoisNum": [num]},
+        attrs={"num_classes": num_classes, "resolution": resolution})
+    return mask_rois, roi_has_mask, mask_int32

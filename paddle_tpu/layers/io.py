@@ -210,3 +210,135 @@ def random_data_generator(low, high, shapes, lod_levels=None,
                 for s in shapes)
 
     return reader
+
+
+class Preprocessor:
+    """In-graph reader preprocessing block (reference: layers/io.py:1082
+    create_custom_reader/Preprocessor). The reference runs the sub-block
+    per batch inside the C++ custom-reader op; here the block is built in
+    its own Program and jit-compiled once through the engine, so the
+    per-batch transform runs as a single XLA executable — the TPU-native
+    equivalent of the reference's sub-block execution.
+
+    Usage matches the reference::
+
+        p = fluid.layers.Preprocessor(reader=my_py_reader)
+        with p.block():
+            img, lbl = p.inputs()
+            p.outputs(img / 2, lbl + 1)
+        new_reader = p()              # python reader of transformed tuples
+
+    ``reader`` is a python batch reader (callable yielding tuples);
+    ``shapes``/``dtypes`` describe its slots (needed to declare the
+    sub-block inputs; they may carry -1 batch dims).
+    """
+
+    BEFORE_SUB_BLOCK = 0
+    IN_SUB_BLOCK = 1
+    AFTER_SUB_BLOCK = 2
+
+    def __init__(self, reader, name=None, shapes=None, dtypes=None):
+        from paddle_tpu import unique_name
+
+        self.underlying_reader = reader
+        self.name = name or unique_name.generate("create_custom_reader")
+        self.shapes = shapes
+        self.dtypes = dtypes
+        if shapes is None and hasattr(reader, "vars"):
+            # a PyReader carries its slot declarations
+            self.shapes = [list(v.shape) for v in reader.vars]
+            self.dtypes = [str(convert_dtype_to_np(v.dtype))
+                           for v in reader.vars]
+        self.sub_program = None
+        self.source_vars = None
+        self.sink_var_names = None
+        self.status = Preprocessor.BEFORE_SUB_BLOCK
+
+    def _is_completed(self):
+        return (self.sub_program is not None and self.source_vars
+                and self.sink_var_names)
+
+    def block(self):
+        import contextlib
+
+        from paddle_tpu.framework import Program, program_guard
+
+        @contextlib.contextmanager
+        def guard():
+            self.status = Preprocessor.IN_SUB_BLOCK
+            self.sub_program = Program()
+            self._startup = Program()
+            with program_guard(self.sub_program, self._startup):
+                yield
+            self.status = Preprocessor.AFTER_SUB_BLOCK
+            if not self._is_completed():
+                raise RuntimeError(
+                    "The definition of preprocessor is incomplete! Set "
+                    "input and output variables via 'inputs' and "
+                    "'outputs' inside the sub-block.")
+
+        return guard()
+
+    def inputs(self):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor.inputs() can only be invoked inside the "
+                "sub-block.")
+        if self.shapes is None or self.dtypes is None:
+            raise ValueError(
+                "Preprocessor needs BOTH shapes and dtypes (or a "
+                "PyReader) to declare its sub-block inputs")
+        from paddle_tpu import unique_name
+
+        self.source_vars = [
+            data(name=unique_name.generate("preprocessor_source"),
+                 shape=list(shape), dtype=dtype, append_batch_size=False)
+            for shape, dtype in zip(self.shapes, self.dtypes)
+        ]
+        return self.source_vars
+
+    def outputs(self, *outs):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor.outputs() can only be invoked inside the "
+                "sub-block.")
+        self.sink_var_names = [v.name for v in outs]
+
+    def __call__(self, *args, **kwargs):
+        if self.status != Preprocessor.AFTER_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor output can only be retrieved after the "
+                "sub-block is defined.")
+        from paddle_tpu.executor import Executor
+        from paddle_tpu.core_shim import CPUPlace
+
+        exe = Executor(CPUPlace())
+        program = self.sub_program
+        startup = self._startup
+        src_names = [v.name for v in self.source_vars]
+        sinks = list(self.sink_var_names)
+        reader = self.underlying_reader
+
+        def batches():
+            if isinstance(reader, PyReader):
+                # a PyReader pumps dicts keyed by its own var names; remap
+                # positionally onto the sub-block sources
+                reader.start()
+                while True:
+                    fd = reader.next_feed()
+                    if fd is None:
+                        return
+                    yield [fd[n] for n in reader.var_names]
+            else:
+                for batch in (reader() if callable(reader) else reader):
+                    yield batch
+
+        def transformed():
+            # parameters created inside block() live in the sub-block's
+            # startup program; initialize them once
+            exe.run(startup)
+            for batch in batches():
+                feed = dict(zip(src_names, batch))
+                yield tuple(exe.run(program, feed=feed, fetch_list=sinks))
+
+        return transformed

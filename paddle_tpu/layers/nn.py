@@ -9,6 +9,7 @@ from paddle_tpu.initializer import ConstantInitializer, NormalInitializer
 
 __all__ = [
     "fc",
+    "tree_conv",
     "embedding",
     "conv2d",
     "depthwise_conv2d",
@@ -2375,3 +2376,33 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
     helper.append_op(type="fused_attention", inputs=inputs,
                      outputs={"Out": [out]}, attrs=attrs)
     return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution on a per-sample tree structure (reference:
+    layers/nn.py:10276 tree_conv + operators/tree_conv_op.cc).
+    nodes_vector [B, N, F]; edge_set [B, E, 2] 1-based directed edges;
+    returns [B, N, output_size, num_filters]."""
+    helper = LayerHelper("tree_conv", **locals())
+    dtype = nodes_vector.dtype
+    feature_size = nodes_vector.shape[2]
+    w = helper.create_parameter(
+        attr=param_attr, shape=[feature_size, 3, output_size, num_filters],
+        dtype=dtype, is_bias=False)
+    if name is None:
+        out = helper.create_variable_for_type_inference(dtype=dtype)
+    else:
+        out = helper.create_variable(name=name, dtype=dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": max_depth})
+    if bias_attr:
+        pre_activation = helper.append_bias_op(out, dim_start=2)
+    else:
+        pre_activation = out
+    return helper.append_activation(pre_activation)

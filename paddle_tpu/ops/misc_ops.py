@@ -898,3 +898,75 @@ def load_value(ctx, ins, attrs):
         arr = _load_from_file(*key)
         _LOAD_REGISTRY[key] = arr
     return {"Out": [jnp.asarray(arr)]}
+
+
+@register_op("tree_conv", no_grad_inputs=("EdgeSet",))
+def tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (TBCNN) (reference: operators/tree_conv_op.cc
+    + operators/math/tree2col.cc). The reference builds per-root patches by
+    host-side DFS; here tree2col is re-expressed as three dense [N, N]
+    eta-coefficient matrices (top/left/right continuous-binary-tree weights,
+    tree2col.h TreeNode::eta_t/eta_l/eta_r) contracted with the node
+    features, so the whole op is two MXU matmuls per sample instead of a
+    data-dependent traversal.
+
+    NodesVector [B, N, F]; EdgeSet [B, E, 2] int (1-based directed parent->
+    child edges, zero-terminated like construct_tree); Filter [F, 3, O, M].
+    Out [B, N, O, M] with rows past each sample's node count zeroed."""
+    feats = single(ins, "NodesVector")
+    edges = single(ins, "EdgeSet").astype(jnp.int32)
+    w = single(ins, "Filter")
+    max_depth = int(attrs.get("max_depth", 2))
+    B, N, F = feats.shape
+
+    def one_sample(feat, edge):
+        u, v = edge[:, 0], edge[:, 1]
+        # construct_tree stops at the first (0, *) or (*, 0) pair
+        valid = jnp.cumprod((u != 0) & (v != 0)).astype(bool)
+        node_count = jnp.sum(valid) + 1
+        uu = jnp.where(valid, u, 0)
+        vv = jnp.where(valid, v, 0)
+        adj = jnp.zeros((N + 1, N + 1), feats.dtype)
+        adj = adj.at[uu, vv].set(1.0, mode="drop")
+        adj = adj.at[0, :].set(0.0).at[:, 0].set(0.0)
+        # child position among siblings, in edge order (tr[u] ordering)
+        same_parent = (u[None, :] == u[:, None]) & valid[None, :] & \
+            valid[:, None]
+        earlier = jnp.tril(jnp.ones((u.shape[0],) * 2, bool), k=-1)
+        index_e = 1 + jnp.sum(same_parent & earlier, axis=1)
+        pclen_e = jnp.sum(same_parent, axis=1)
+        index_n = jnp.zeros((N + 1,), feats.dtype).at[vv].set(
+            index_e.astype(feats.dtype), mode="drop")
+        pclen_n = jnp.zeros((N + 1,), feats.dtype).at[vv].set(
+            pclen_e.astype(feats.dtype), mode="drop")
+        # depth(root u, node v): first power of adj reaching v, capped at
+        # max_depth-1 (construct_patch only descends while depth+1 <
+        # max_depth)
+        inf = jnp.float32(max_depth)
+        depth = jnp.where(jnp.eye(N + 1, dtype=bool), 0.0, inf)
+        reach = adj
+        for d in range(1, max_depth):
+            depth = jnp.where((depth >= inf) & (reach > 0),
+                              jnp.float32(d), depth)
+            if d + 1 < max_depth:
+                reach = (reach @ adj > 0).astype(feats.dtype)
+        in_patch = depth < inf
+        nodes = jnp.arange(N + 1)
+        valid_node = (nodes >= 1) & (nodes <= node_count)
+        in_patch &= valid_node[:, None] & valid_node[None, :]
+        # eta weights (tree2col.h): the patch root carries index=1, pclen=1
+        root = jnp.eye(N + 1, dtype=bool)
+        idx = jnp.where(root, 1.0, index_n[None, :])
+        pcl = jnp.where(root, 1.0, pclen_n[None, :])
+        md = jnp.float32(max_depth)
+        eta_t = (md - depth) / md
+        frac = jnp.where(pcl == 1, 0.5,
+                         (idx - 1.0) / jnp.maximum(pcl - 1.0, 1.0))
+        eta_l = (1.0 - eta_t) * frac
+        eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+        coef = jnp.stack([eta_l, eta_r, eta_t])          # [3, N+1, N+1]
+        coef = jnp.where(in_patch[None], coef, 0.0)[:, 1:, 1:]
+        patch = jnp.einsum("cuv,vf->ucf", coef, feat)    # [N, 3, F]
+        return jnp.einsum("ucf,fcom->uom", patch, w)     # [N, O, M]
+
+    return {"Out": [jax.vmap(one_sample)(feats, edges)]}

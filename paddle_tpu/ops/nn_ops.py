@@ -197,6 +197,7 @@ def _bn_axes(x, layout):
 @register_op(
     "batch_norm",
     no_grad_inputs=("Mean", "Variance"),
+    grad_needs_outputs=("SavedMean", "SavedVariance"),
 )
 def batch_norm(ctx, ins, attrs):
     x = single(ins, "X")  # NCHW or ND(C last? paddle: NCHW default)

@@ -12,10 +12,7 @@ import paddle_tpu.fluid as fluid
 
 # Documented gaps (COVERAGE.md "Remaining known gaps") — everything else
 # in the reference's layers __all__ must resolve.
-KNOWN_GAPS = {
-    "Preprocessor", "generate_mask_labels",
-    "roi_perspective_transform", "tree_conv",
-}
+KNOWN_GAPS = set()
 
 REFERENCE_LAYER_FILES = ["nn.py", "tensor.py", "control_flow.py",
                          "ops.py", "io.py", "metric_op.py",

@@ -1,0 +1,392 @@
+"""The last four layer-surface entries (VERDICT r2 Missing #5):
+tree_conv, roi_perspective_transform, generate_mask_labels, Preprocessor.
+
+Oracles are independent numpy ports of the reference algorithms
+(operators/math/tree2col.cc DFS patches, roi_perspective_transform_op.cc
+projective sampling on axis-aligned quads where the warp is exact,
+mask_util.cc polygon rasterization on rectangles where even-odd equals
+the RLE walk). Mirrors tests/unittests/test_tree_conv_op.py,
+test_roi_perspective_transform_op.py, test_generate_mask_labels_op.py.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _run(build, feed):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed, fetch_list=list(fetch))
+    return [np.asarray(o) for o in outs]
+
+
+# -- tree_conv --------------------------------------------------------------
+
+def _tree_conv_ref(feats, edges, w, max_depth):
+    """Direct port of tree2col.cc construct_tree/construct_patch + the
+    eta weights of tree2col.h, then the TreeConvKernel matmul."""
+    B, N, F = feats.shape
+    O, M = w.shape[2], w.shape[3]
+    out = np.zeros((B, N, O, M), np.float32)
+    for b in range(B):
+        tr = {}
+        node_count = 1
+        for (u, v) in edges[b]:
+            if u == 0 or v == 0:
+                break
+            tr.setdefault(int(u), []).append(int(v))
+            node_count += 1
+        for root in range(1, node_count + 1):
+            patch = [(root, 1, 1, 0)]
+            stack = [(root, 0)]
+            visited = {root}
+            while stack:
+                node, depth = stack[-1]
+                end = True
+                for i, v in enumerate(tr.get(node, [])):
+                    if v not in visited and depth + 1 < max_depth:
+                        visited.add(v)
+                        stack.append((v, depth + 1))
+                        patch.append((v, i + 1, len(tr[node]), depth + 1))
+                        end = False
+                if end:
+                    stack.pop()
+            acc = np.zeros((F, 3), np.float64)
+            for (nd, idx, pclen, depth) in patch:
+                eta_t = (max_depth - depth) / max_depth
+                tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1 - eta_t) * tmp
+                eta_r = (1 - eta_t) * (1 - eta_l)
+                acc[:, 0] += eta_l * feats[b, nd - 1]
+                acc[:, 1] += eta_r * feats[b, nd - 1]
+                acc[:, 2] += eta_t * feats[b, nd - 1]
+            out[b, root - 1] = np.einsum("fc,fcom->om", acc, w)
+    return out
+
+
+def test_tree_conv_matches_dfs_oracle():
+    rng = np.random.RandomState(7)
+    B, N, F, O, M = 2, 10, 5, 6, 2
+    feats = rng.randn(B, N, F).astype(np.float32)
+    #        1            1
+    #       / \          / \
+    #      2   3        2   3
+    #     /|\               |
+    #    4 5 6              4
+    edges = np.zeros((B, N, 2), np.int32)
+    edges[0, :5] = [[1, 2], [1, 3], [2, 4], [2, 5], [2, 6]]
+    edges[1, :3] = [[1, 2], [1, 3], [3, 4]]
+    w = rng.randn(F, 3, O, M).astype(np.float32)
+
+    def build():
+        nv = fluid.layers.data(name="nv", shape=[N, F], dtype="float32")
+        es = fluid.layers.data(name="es", shape=[N, 2], dtype="int32")
+        out = fluid.layers.tree_conv(
+            nv, es, O, num_filters=M, max_depth=2, act=None,
+            bias_attr=False,
+            param_attr=fluid.ParamAttr(name="tcw"))
+        return [out]
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set("tcw", w)
+        (out,) = exe.run(main, feed={"nv": feats, "es": edges},
+                         fetch_list=fetch)
+    ref = _tree_conv_ref(feats, edges, w, max_depth=2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_tree_conv_depth3_and_grad():
+    rng = np.random.RandomState(3)
+    B, N, F, O = 1, 8, 4, 3
+    feats = rng.randn(B, N, F).astype(np.float32)
+    edges = np.zeros((B, N, 2), np.int32)
+    edges[0, :4] = [[1, 2], [2, 3], [3, 4], [1, 5]]  # a chain + a leaf
+    w = rng.randn(F, 3, O, 1).astype(np.float32)
+
+    def build():
+        nv = fluid.layers.data(name="nv", shape=[N, F], dtype="float32")
+        nv.stop_gradient = False
+        es = fluid.layers.data(name="es", shape=[N, 2], dtype="int32")
+        out = fluid.layers.tree_conv(
+            nv, es, O, num_filters=1, max_depth=3, act="tanh",
+            bias_attr=False, param_attr=fluid.ParamAttr(name="tcw3"))
+        loss = fluid.layers.reduce_sum(out)
+        grads = fluid.append_backward(loss)
+        gmap = {p.name: g for p, g in grads}
+        return [out, gmap["tcw3"]]
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set("tcw3", w)
+        out, gw = exe.run(main, feed={"nv": feats, "es": edges},
+                          fetch_list=fetch)
+    ref = np.tanh(_tree_conv_ref(feats, edges, w, max_depth=3))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+    # FD check one filter weight through the engine-built backward
+    eps = 1e-3
+    for (i, j, k, l) in [(0, 0, 0, 0), (2, 1, 1, 0), (3, 2, 2, 0)]:
+        wp, wm = w.copy(), w.copy()
+        wp[i, j, k, l] += eps
+        wm[i, j, k, l] -= eps
+        fp = np.sum(np.tanh(_tree_conv_ref(feats, edges, wp, 3)))
+        fm = np.sum(np.tanh(_tree_conv_ref(feats, edges, wm, 3)))
+        np.testing.assert_allclose(
+            np.asarray(gw)[i, j, k, l], (fp - fm) / (2 * eps),
+            rtol=2e-2, atol=1e-3)
+
+
+# -- roi_perspective_transform ---------------------------------------------
+
+def test_roi_perspective_transform_axis_aligned():
+    """An axis-aligned square quad degenerates to a plain affine resize:
+    output grid point (i, j) samples the input at an exactly computable
+    location."""
+    H = W = 8
+    img = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+    # quad: top-left (1,1) -> top-right (6,1) -> bottom-right (6,6) ->
+    # bottom-left (1,6); clockwise as the reference expects
+    rois = np.array([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32)
+    th = tw = 6
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[1, H, W], dtype="float32")
+        r = fluid.layers.data(name="r", shape=[8], dtype="float32")
+        out = fluid.layers.roi_perspective_transform(x, r, th, tw, 1.0)
+        return [out]
+
+    (out,) = _run(build, {"x": img, "r": rois})
+    assert out.shape == (1, 1, th, tw)
+    # est width == est height == 5 -> normalized grid steps of 1: output
+    # (i, j) samples input (1 + j, 1 + i) exactly
+    for i in range(th):
+        for j in range(tw):
+            np.testing.assert_allclose(
+                out[0, 0, i, j], img[0, 0, 1 + i, 1 + j], rtol=1e-4)
+
+
+def test_roi_perspective_transform_outside_zero():
+    """Grid points mapping outside the feature map (quad hanging off the
+    edge) are zeroed."""
+    H = W = 6
+    img = np.ones((1, 1, H, W), np.float32)
+    rois = np.array([[-3, -3, 2, -3, 2, 2, -3, 2]], np.float32)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[1, H, W], dtype="float32")
+        r = fluid.layers.data(name="r", shape=[8], dtype="float32")
+        out = fluid.layers.roi_perspective_transform(x, r, 6, 6, 1.0)
+        return [out]
+
+    (out,) = _run(build, {"x": img, "r": rois})
+    # top-left of the grid falls outside the map -> 0; bottom-right
+    # lands inside -> 1
+    assert out[0, 0, 0, 0] == 0.0
+    assert out[0, 0, 5, 5] == 1.0
+
+
+# -- generate_mask_labels ---------------------------------------------------
+
+def test_generate_mask_labels_rectangle():
+    """One fg gt whose segmentation is a rectangle: the mask target inside
+    a roi equal to the polygon bbox is all ones in the gt class slice."""
+    R, G, P, V, K, M = 4, 2, 1, 8, 3, 8
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    gt_classes = np.array([2, 0], np.int32)
+    is_crowd = np.array([0, 0], np.int32)
+    segms = np.zeros((G, P, V, 2), np.float32)
+    # rectangle (4,4)-(20,20); vertex grid offset by .5 so no grid line
+    # ambiguity after warping to the M x M grid
+    segms[0, 0, :4] = [[4, 4], [20, 4], [20, 20], [4, 20]]
+    poly_lens = np.zeros((G, P), np.int32)
+    poly_lens[0, 0] = 4
+    rois = np.array([[4, 4, 20, 20],        # fg: exactly the gt box
+                     [0, 0, 8, 8],          # bg
+                     [5, 5, 19, 19],        # fg: inside the gt box
+                     [0, 0, 4, 4]], np.float32)
+    labels = np.array([2, 0, 2, 0], np.int32)
+
+    def build():
+        ii = fluid.layers.data(name="ii", shape=[3], dtype="float32")
+        gc = fluid.layers.data(name="gc", shape=[1], dtype="int32")
+        ic = fluid.layers.data(name="ic", shape=[1], dtype="int32")
+        gs = fluid.layers.data(name="gs", shape=[P, V, 2],
+                               dtype="float32")
+        pl = fluid.layers.data(name="pl", shape=[P], dtype="int32")
+        ro = fluid.layers.data(name="ro", shape=[4], dtype="float32")
+        lb = fluid.layers.data(name="lb", shape=[1], dtype="int32")
+        outs = fluid.layers.generate_mask_labels(
+            ii, gc, ic, gs, ro, lb, num_classes=K, resolution=M,
+            gt_poly_lens=pl)
+        return list(outs)
+
+    mask_rois, has_mask, mask = _run(build, {
+        "ii": im_info, "gc": gt_classes, "ic": is_crowd, "gs": segms,
+        "pl": poly_lens, "ro": rois, "lb": labels})
+    assert mask_rois.shape == (R, 4)
+    assert mask.shape == (R, K * M * M)
+    # two fg rois, original indices 0 and 2, in order
+    np.testing.assert_array_equal(has_mask.ravel()[:2], [0, 2])
+    assert (has_mask.ravel()[2:] == -1).all()
+    np.testing.assert_allclose(mask_rois[0], rois[0])
+    np.testing.assert_allclose(mask_rois[1], rois[2])
+    # row 0: roi == polygon bbox -> class-2 slice rasterizes (nearly)
+    # full; other class slices stay -1
+    m0 = mask[0].reshape(K, M, M)
+    assert (m0[0] == -1).all() and (m0[1] == -1).all()
+    # interior of the warped rectangle: all grid points are inside
+    assert (m0[2][1:-1, 1:-1] == 1).all()
+    # padding rows are all -1
+    assert (mask[2] == -1).all() and (mask[3] == -1).all()
+
+
+def test_generate_mask_labels_no_fg_fallback():
+    """No fg roi: the reference emits one bg row (class 0, all -1 mask)."""
+    G, P, V, K, M = 1, 1, 4, 2, 4
+    feed = {
+        "ii": np.array([[16, 16, 1.0]], np.float32),
+        "gc": np.array([1], np.int32),
+        "ic": np.array([0], np.int32),
+        "gs": np.zeros((G, P, V, 2), np.float32),
+        "pl": np.full((G, P), 4, np.int32),
+        "ro": np.array([[0, 0, 8, 8], [1, 1, 9, 9]], np.float32),
+        "lb": np.array([0, 0], np.int32),
+    }
+
+    def build():
+        ii = fluid.layers.data(name="ii", shape=[3], dtype="float32")
+        gc = fluid.layers.data(name="gc", shape=[1], dtype="int32")
+        ic = fluid.layers.data(name="ic", shape=[1], dtype="int32")
+        gs = fluid.layers.data(name="gs", shape=[P, V, 2],
+                               dtype="float32")
+        pl = fluid.layers.data(name="pl", shape=[P], dtype="int32")
+        ro = fluid.layers.data(name="ro", shape=[4], dtype="float32")
+        lb = fluid.layers.data(name="lb", shape=[1], dtype="int32")
+        outs = fluid.layers.generate_mask_labels(
+            ii, gc, ic, gs, ro, lb, num_classes=K, resolution=M,
+            gt_poly_lens=pl)
+        return list(outs)
+
+    mask_rois, has_mask, mask = _run(build, feed)
+    # one kept row: the first bg roi, with an all -1 (ignore) mask
+    np.testing.assert_allclose(mask_rois[0], feed["ro"][0])
+    assert has_mask.ravel()[0] == 0
+    assert (mask[0] == -1).all()
+    assert (has_mask.ravel()[1:] == -1).all()
+
+
+# -- Preprocessor -----------------------------------------------------------
+
+def test_preprocessor_block():
+    """The reference scenario (layers/io.py Preprocessor docstring): halve
+    images, shift labels, through the compiled sub-block."""
+    batches = [(np.full((2, 3), i, np.float32),
+                np.array([i, i], np.int64)) for i in range(4)]
+
+    def rd():
+        for b in batches:
+            yield b
+
+    p = fluid.layers.Preprocessor(reader=rd, shapes=[[2, 3], [2]],
+                                  dtypes=["float32", "int64"])
+    with p.block():
+        img, lbl = p.inputs()
+        img_out = fluid.layers.scale(img, scale=0.5)
+        lbl_out = lbl + 1
+        p.outputs(img_out, lbl_out)
+    out = [tuple(np.asarray(t) for t in item) for item in p()()]
+    assert len(out) == 4
+    for i, (img, lbl) in enumerate(out):
+        np.testing.assert_allclose(img, np.full((2, 3), i * 0.5))
+        np.testing.assert_allclose(lbl, np.array([i + 1, i + 1]))
+
+    # incomplete block is an error, as in the reference
+    p2 = fluid.layers.Preprocessor(reader=rd, shapes=[[2, 3]],
+                                   dtypes=["float32"])
+    try:
+        with p2.block():
+            p2.inputs()
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+
+
+def test_preprocessor_pyreader_and_params():
+    """The PyReader-backed path and a parameterized sub-block (both were
+    code-review findings: PyReader batches are dicts; block params need
+    their startup run)."""
+    from paddle_tpu.framework import Program as _P, program_guard as _pg
+
+    main, startup = _P(), _P()
+    with _pg(main, startup):
+        pr = fluid.layers.py_reader(capacity=4, shapes=[[2, 3], [2, 1]],
+                                    dtypes=["float32", "int64"])
+
+    def src():
+        for i in range(3):
+            yield (np.full((2, 3), i, np.float32),
+                   np.full((2, 1), i, np.int64))
+
+    pr.decorate_paddle_reader(src)
+    p = fluid.layers.Preprocessor(reader=pr)
+    with p.block():
+        a, b = p.inputs()
+        p.outputs(fluid.layers.scale(a, scale=10.0), b)
+    vals = [(float(np.asarray(x).ravel()[0]), int(np.asarray(y).ravel()[0]))
+            for x, y in p()()]
+    assert vals == [(0.0, 0), (10.0, 1), (20.0, 2)], vals
+
+    def src2():
+        yield (np.ones((2, 3), np.float32), np.zeros((2, 1), np.int64))
+
+    p2 = fluid.layers.Preprocessor(reader=src2, shapes=[[2, 3], [2, 1]],
+                                   dtypes=["float32", "int64"])
+    with p2.block():
+        a, b = p2.inputs()
+        p2.outputs(fluid.layers.fc(input=a, size=4), b)
+    out = list(p2()())
+    assert np.asarray(out[0][0]).shape == (2, 4)
+
+    try:
+        p3 = fluid.layers.Preprocessor(reader=src2, shapes=[[2, 3]])
+        with p3.block():
+            p3.inputs()
+        assert False, "expected an error for missing dtypes"
+    except (ValueError, RuntimeError):
+        pass
+
+
+def test_batch_norm_grad_receives_saved_stats():
+    """append_backward wires SavedMean/SavedVariance into batch_norm_grad
+    (code-review finding: the direct-from-saved-stats path was dead)."""
+    from paddle_tpu.framework import Program as _P, program_guard as _pg
+
+    main, startup = _P(), _P()
+    with _pg(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 8, 8], dtype="float32")
+        y = fluid.layers.batch_norm(x)
+        loss = fluid.layers.reduce_sum(y)
+        fluid.append_backward(loss)
+    gops = [op for op in main.global_block().desc.ops
+            if op.type == "batch_norm_grad"]
+    assert gops, "no batch_norm_grad op appended"
+    assert "SavedMean" in gops[0].inputs
+    assert "SavedVariance" in gops[0].inputs
