@@ -51,6 +51,7 @@ from paddle_tpu.parallel_executor import (  # noqa: F401
     BuildStrategy,
 )
 from paddle_tpu import io  # noqa: F401
+from paddle_tpu import imperative  # noqa: F401
 from paddle_tpu import transpiler  # noqa: F401
 from paddle_tpu.transpiler import (  # noqa: F401
     DistributeTranspiler,

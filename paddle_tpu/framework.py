@@ -98,6 +98,52 @@ class Variable:
             getattr(self.dtype, "name", self.dtype),
         )
 
+    # -- imperative-mode value/autograd access (reference: framework.py
+    # Variable._numpy/_backward/_gradient over the pybind VarBase) ---------
+    def _numpy(self):
+        import numpy as np
+
+        tracer = _imperative_tracer_
+        if tracer is None:
+            raise RuntimeError(
+                "Variable._numpy() only works in imperative mode "
+                "(fluid.imperative.guard)")
+        val = tracer.env.get(self.name)
+        if val is None:
+            raise RuntimeError(
+                "Variable %r has no value yet" % self.name)
+        return np.asarray(val)
+
+    def _backward(self):
+        if _imperative_tracer_ is None:
+            raise RuntimeError(
+                "Variable._backward() only works in imperative mode")
+        from paddle_tpu.backward import append_backward
+
+        # grad ops execute eagerly as append_backward emits them (the
+        # Block.append_op tracer hook), so this both builds and runs the
+        # backward pass
+        append_backward(self)
+
+    def _gradient(self):
+        import numpy as np
+
+        tracer = _imperative_tracer_
+        if tracer is None:
+            raise RuntimeError(
+                "Variable._gradient() only works in imperative mode")
+        g = tracer.env.get(grad_var_name(self.name))
+        if g is None:
+            raise RuntimeError(
+                "Variable %r has no gradient; call loss._backward() "
+                "first (or the var does not require grad)" % self.name)
+        return np.asarray(g)
+
+    def _clear_gradient(self):
+        tracer = _imperative_tracer_
+        if tracer is not None:
+            tracer.env.pop(grad_var_name(self.name), None)
+
     __str__ = __repr__
 
     # -- operator sugar (subset of reference's monkey-patched math ops) ----
@@ -337,6 +383,8 @@ class Block:
             attrs[OP_ROLE_VAR_KEY] = list(self.program._op_role_var)
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.append(op)
+        if _imperative_tracer_ is not None:
+            _imperative_tracer_.trace_op(op.desc, self.desc)
         return op
 
     def all_parameters(self):
@@ -546,3 +594,28 @@ class program_guard:
 
 def grad_var_name(name):
     return name + "@GRAD"
+
+
+# -- imperative (dygraph) mode plumbing (reference: framework.py
+# _imperative_tracer_/_imperative_guard; the hook lives in Block.append_op) --
+
+_imperative_tracer_ = None
+
+
+def _imperative_tracer():
+    return _imperative_tracer_
+
+
+def _in_imperative_mode():
+    return _imperative_tracer_ is not None
+
+
+@contextlib.contextmanager
+def _imperative_guard(tracer):
+    global _imperative_tracer_
+    prev = _imperative_tracer_
+    _imperative_tracer_ = tracer
+    try:
+        yield
+    finally:
+        _imperative_tracer_ = prev

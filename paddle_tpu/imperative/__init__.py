@@ -1,0 +1,14 @@
+"""Imperative (dygraph) mode (reference:
+python/paddle/fluid/imperative/__init__.py)."""
+
+from paddle_tpu.imperative import base
+from paddle_tpu.imperative.base import enabled, guard, to_variable  # noqa
+from paddle_tpu.imperative import layers
+from paddle_tpu.imperative.layers import Layer, PyLayer  # noqa
+from paddle_tpu.imperative import nn
+from paddle_tpu.imperative.nn import Conv2D, Pool2D, FC  # noqa
+
+__all__ = ["enabled", "guard", "to_variable", "Layer", "PyLayer",
+           "Conv2D", "Pool2D", "FC", "BatchNorm", "Embedding"]
+
+from paddle_tpu.imperative.nn import BatchNorm, Embedding  # noqa

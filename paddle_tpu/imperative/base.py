@@ -1,0 +1,91 @@
+"""Imperative (dygraph) execution embryo (reference:
+python/paddle/fluid/imperative/base.py — guard:20, to_variable:46, plus
+the pybind Tracer of imperative.cc).
+
+TPU-native redesign: the reference traces each appended op into a C++
+Tracer that executes it immediately and keeps a VarBase autograd graph.
+Here JAX *is* eager outside jit, so the Tracer simply runs every op desc
+through the engine's single-op interpreter (engine/lowering.py run_op)
+the moment a layer appends it, holding live jax arrays in an environment
+dict. ``Variable._backward`` replays the recorded program through the
+same ``append_backward`` machinery the static graph uses — each grad op
+executes eagerly as it is appended, so no separate autograd tape is
+needed.
+"""
+
+import contextlib
+
+import numpy as np
+
+from paddle_tpu import framework
+
+__all__ = ["enabled", "guard", "to_variable"]
+
+
+class Tracer:
+    """Eager op executor: holds the value environment and the RNG stream
+    (the counterpart of the reference's pybind Tracer, imperative.cc)."""
+
+    def __init__(self, seed=0):
+        import jax
+
+        self.env = {}
+        self._rng_key = jax.random.PRNGKey(seed)
+        self._count = 0
+
+    def trace_op(self, op, block):
+        from paddle_tpu.engine.lowering import run_op
+
+        run_op(op, block, self.env, self._rng_key, self._count,
+               is_test=False)
+        self._count += 1
+        # backfill output var shapes/dtypes so downstream layers (FC
+        # _build_once etc.) can read them — the static graph gets these
+        # from infer_shape; eager mode gets them from the actual arrays
+        for names in op.outputs.values():
+            for n in names:
+                val = self.env.get(n)
+                vd = block.vars.get(n)
+                if val is not None and vd is not None and hasattr(
+                        val, "shape"):
+                    vd.shape = list(val.shape)
+
+    def value(self, name):
+        return self.env.get(name)
+
+
+def enabled():
+    return framework._imperative_tracer() is not None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enter imperative mode (reference: imperative/base.py:20). ``place``
+    is accepted for API parity; JAX's default device policy applies."""
+    from paddle_tpu import unique_name
+
+    train = framework.Program()
+    startup = framework.Program()
+    tracer = Tracer()
+    with framework.program_guard(train, startup):
+        with unique_name.guard():
+            with framework._imperative_guard(tracer):
+                yield
+
+
+def to_variable(value, block=None):
+    """Wrap a numpy array as an eager Variable (reference:
+    imperative/base.py:46)."""
+    if isinstance(value, framework.Variable):
+        return value
+    if not isinstance(value, np.ndarray):
+        value = np.asarray(value)
+    assert enabled(), "to_variable could only be called in imperative mode"
+    import jax.numpy as jnp
+
+    if block is None:
+        block = framework.default_main_program().current_block()
+    py_var = block.create_var(
+        shape=list(value.shape), dtype=value.dtype, stop_gradient=False)
+    framework._imperative_tracer().env[py_var.name] = jnp.asarray(value)
+    return py_var

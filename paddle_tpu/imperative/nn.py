@@ -1,0 +1,207 @@
+"""Imperative layer prototypes (reference:
+python/paddle/fluid/imperative/nn.py — Conv2D:28, Pool2D:144, FC:206,
+BatchNorm:283, Embedding:410). Each builds its ops through the shared
+LayerHelper; in imperative mode every appended op (including the
+parameter init ops in the startup program) executes eagerly through the
+tracer, so forward returns live values."""
+
+import numpy as np
+
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.initializer import NormalInitializer
+from paddle_tpu.imperative.layers import Layer
+
+__all__ = ["Conv2D", "Pool2D", "FC", "BatchNorm", "Embedding"]
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, use_cudnn=True,
+                 act=None, param_attr=None, bias_attr=None, name=None,
+                 dtype="float32"):
+        assert param_attr is not False, "param_attr should not be False"
+        super().__init__(name=name, dtype=dtype)
+        self._helper = LayerHelper(
+            type(self).__name__, param_attr=param_attr,
+            bias_attr=bias_attr, dtype=dtype, name=name, act=act)
+        self._groups = groups
+        self._stride = _pair(stride)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._num_channels = num_channels
+        filter_size = _pair(filter_size)
+        num_filter_channels = (num_channels if groups is None
+                               else num_channels // groups)
+        filter_shape = [num_filters, int(num_filter_channels)] + filter_size
+        std = (2.0 / (filter_size[0] * filter_size[1] * num_channels)) ** 0.5
+        self._filter_param = self._helper.create_parameter(
+            attr=self._helper.kwargs.get("param_attr"),
+            shape=filter_shape, dtype=dtype,
+            default_initializer=NormalInitializer(0.0, std))
+        self._bias_param = (
+            None if bias_attr is False else self._helper.create_parameter(
+                attr=bias_attr, shape=[num_filters], dtype=dtype,
+                is_bias=True))
+
+    def forward(self, input):
+        pre_bias = self._helper.create_variable_for_type_inference(
+            self._dtype)
+        self._helper.append_op(
+            type="conv2d",
+            inputs={"Input": [input], "Filter": [self._filter_param]},
+            outputs={"Output": [pre_bias]},
+            attrs={"strides": self._stride, "paddings": self._padding,
+                   "dilations": self._dilation,
+                   "groups": self._groups or 1})
+        if self._bias_param is not None:
+            pre_act = self._helper.create_variable_for_type_inference(
+                self._dtype)
+            self._helper.append_op(
+                type="elementwise_add",
+                inputs={"X": [pre_bias], "Y": [self._bias_param]},
+                outputs={"Out": [pre_act]}, attrs={"axis": 1})
+        else:
+            pre_act = pre_bias
+        return self._helper.append_activation(pre_act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, name=None,
+                 dtype="float32"):
+        if pool_type not in ("max", "avg"):
+            raise ValueError("pool_type must be 'max' or 'avg'")
+        super().__init__(name=name, dtype=dtype)
+        self._helper = LayerHelper(type(self).__name__, name=name)
+        self._pool_size = _pair(pool_size)
+        self._pool_type = pool_type
+        self._pool_stride = _pair(pool_stride)
+        self._pool_padding = _pair(pool_padding)
+        self._global_pooling = global_pooling
+        self._ceil_mode = ceil_mode
+        self._exclusive = exclusive
+
+    def forward(self, input):
+        out = self._helper.create_variable_for_type_inference(self._dtype)
+        self._helper.append_op(
+            type="pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+            attrs={"pooling_type": self._pool_type,
+                   "ksize": self._pool_size,
+                   "global_pooling": self._global_pooling,
+                   "strides": self._pool_stride,
+                   "paddings": self._pool_padding,
+                   "ceil_mode": self._ceil_mode,
+                   "exclusive": self._exclusive})
+        return out
+
+
+class FC(Layer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 num_flatten_dims=1, act=None, name=None, dtype="float32"):
+        super().__init__(name=name, dtype=dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._helper = LayerHelper(
+            "FC", param_attr=param_attr, bias_attr=bias_attr, act=act,
+            name=name)
+
+    def _build_once(self, input):
+        input_shape = input.shape
+        param_shape = [
+            int(np.prod(input_shape[self._num_flatten_dims:])), self._size
+        ]
+        self._w = self._helper.create_parameter(
+            attr=self._helper.kwargs.get("param_attr"),
+            shape=param_shape, dtype=self._dtype, is_bias=False)
+
+    def forward(self, input):
+        tmp = self._helper.create_variable_for_type_inference(self._dtype)
+        self._helper.append_op(
+            type="mul", inputs={"X": [input], "Y": [self._w]},
+            outputs={"Out": [tmp]},
+            attrs={"x_num_col_dims": self._num_flatten_dims,
+                   "y_num_col_dims": 1})
+        pre_activation = self._helper.append_bias_op(tmp)
+        return self._helper.append_activation(pre_activation)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 data_layout="NCHW", name=None, dtype="float32"):
+        super().__init__(name=name, dtype=dtype)
+        self._helper = LayerHelper(
+            "BatchNorm", param_attr=param_attr, bias_attr=bias_attr,
+            act=act, name=name)
+        from paddle_tpu.initializer import ConstantInitializer
+        from paddle_tpu.param_attr import ParamAttr
+
+        self._scale = self._helper.create_parameter(
+            attr=param_attr, shape=[num_channels], dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self._bias = self._helper.create_parameter(
+            attr=bias_attr, shape=[num_channels], dtype=dtype, is_bias=True)
+        self._mean = self._helper.create_parameter(
+            attr=ParamAttr(
+                name=None, initializer=ConstantInitializer(0.0),
+                trainable=False),
+            shape=[num_channels], dtype=dtype)
+        self._variance = self._helper.create_parameter(
+            attr=ParamAttr(
+                name=None, initializer=ConstantInitializer(1.0),
+                trainable=False),
+            shape=[num_channels], dtype=dtype)
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._is_test = is_test
+
+    def forward(self, input):
+        h = self._helper
+        saved_mean = h.create_variable_for_type_inference(
+            self._dtype, stop_gradient=True)
+        saved_var = h.create_variable_for_type_inference(
+            self._dtype, stop_gradient=True)
+        out = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(
+            type="batch_norm",
+            inputs={"X": [input], "Scale": [self._scale],
+                    "Bias": [self._bias], "Mean": [self._mean],
+                    "Variance": [self._variance]},
+            outputs={"Y": [out], "MeanOut": [self._mean],
+                     "VarianceOut": [self._variance],
+                     "SavedMean": [saved_mean],
+                     "SavedVariance": [saved_var]},
+            attrs={"momentum": self._momentum, "epsilon": self._epsilon,
+                   "data_layout": self._data_layout,
+                   "is_test": self._is_test})
+        return h.append_activation(out)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32",
+                 name=None):
+        super().__init__(name=name, dtype=dtype)
+        self._size = size
+        self._is_sparse = is_sparse
+        self._padding_idx = (-1 if padding_idx is None else padding_idx)
+        self._helper = LayerHelper("Embedding", param_attr=param_attr,
+                                   name=name)
+        self._w = self._helper.create_parameter(
+            attr=param_attr, shape=size, dtype=dtype, is_bias=False)
+
+    def forward(self, input):
+        out = self._helper.create_variable_for_type_inference(self._dtype)
+        self._helper.append_op(
+            type="lookup_table",
+            inputs={"Ids": [input], "W": [self._w]},
+            outputs={"Out": [out]},
+            attrs={"is_sparse": self._is_sparse,
+                   "padding_idx": self._padding_idx})
+        return out
