@@ -1,5 +1,5 @@
-"""Dataset loaders (reference: python/paddle/dataset/ — mnist.py, cifar.py,
-imdb.py, uci_housing.py). The image has zero egress, so loaders read from a
+"""Dataset loaders (reference: python/paddle/dataset/ — all 13 loader
+modules). The image has zero egress, so loaders read from a
 local data directory when present and otherwise serve deterministic
 synthetic data with the real shapes/vocabularies — enough for the training
 pipeline, tests, and benchmarks to run unmodified."""
@@ -8,3 +8,12 @@ from paddle_tpu.dataset import mnist  # noqa: F401
 from paddle_tpu.dataset import cifar  # noqa: F401
 from paddle_tpu.dataset import imdb  # noqa: F401
 from paddle_tpu.dataset import uci_housing  # noqa: F401
+from paddle_tpu.dataset import flowers  # noqa: F401
+from paddle_tpu.dataset import wmt14  # noqa: F401
+from paddle_tpu.dataset import wmt16  # noqa: F401
+from paddle_tpu.dataset import movielens  # noqa: F401
+from paddle_tpu.dataset import imikolov  # noqa: F401
+from paddle_tpu.dataset import conll05  # noqa: F401
+from paddle_tpu.dataset import sentiment  # noqa: F401
+from paddle_tpu.dataset import mq2007  # noqa: F401
+from paddle_tpu.dataset import voc2012  # noqa: F401
