@@ -549,8 +549,8 @@ class DistTrainer:
         self.exe = fluid.Executor()
         # send/recv markers carry the routing; the compiled program runs
         # without them (the transport is this class)
-        self._sends = []   # (grad_name, endpoint)
-        self._recvs = []   # (param_name, endpoint)
+        self._sends = []   # (grad_name, endpoint, wire_name, rows|None)
+        self._recvs = []   # (param_name, endpoint, wire_name, rows|None)
         self.program = trainer_program.clone()
         block = self.program.desc.global_block()
         kept = []
@@ -563,10 +563,14 @@ class DistTrainer:
         for op in block.ops:
             if op.type == "send":
                 self._sends.append(
-                    (op.inputs["X"][0], op.attrs["endpoints"][0]))
+                    (op.inputs["X"][0], op.attrs["endpoints"][0],
+                     op.attrs.get("wire", op.inputs["X"][0]),
+                     op.attrs.get("rows")))
             elif op.type == "recv":
                 self._recvs.append(
-                    (op.outputs["Out"][0], op.attrs["endpoints"][0]))
+                    (op.outputs["Out"][0], op.attrs["endpoints"][0],
+                     op.attrs.get("wire", op.outputs["Out"][0]),
+                     op.attrs.get("rows")))
             else:
                 if op.type == "distributed_lookup":
                     wname = op.attrs["table_name"]
@@ -578,7 +582,7 @@ class DistTrainer:
                 kept.append(op)
         block.ops = kept
         self.program._bump_version()
-        eps = sorted({ep for _, ep in self._sends + self._recvs}
+        eps = sorted({ep for _, ep, _, _ in self._sends + self._recvs}
                      | {ep for *_, shards in self._dist
                         for ep, _, _ in shards})
         self.client = PSClient(eps)
@@ -594,8 +598,23 @@ class DistTrainer:
 
     def pull_params(self):
         """Initial sync so all trainers start from the pserver's params."""
-        for name, ep in self._recvs:
-            self.scope.set(name, self.client.get_var(ep, name))
+        self._recv_all()
+
+    def _recv_all(self):
+        """Fetch every param — whole vars directly, sliced vars assembled
+        from their row blocks (reference: recv + concat of VarBlocks)."""
+        for name, ep, wire, rows in self._recvs:
+            part = self.client.get_var(ep, wire)
+            if rows is None:
+                self.scope.set(name, part)
+                continue
+            cur = self.scope.get(name)
+            cur = np.array(cur) if cur is not None else None
+            if cur is None or cur.shape[0] < rows[1]:
+                raise RuntimeError(
+                    "sliced param %r not materialized trainer-side" % name)
+            cur[rows[0]:rows[1]] = part
+            self.scope.set(name, cur)
 
     def run(self, feed, fetch_list):
         # -- prefetch distributed-table rows for this batch's ids ---------
@@ -632,7 +651,7 @@ class DistTrainer:
             feed[pref_var] = rows[inv]
             dist_ctx.append((wname, pref_var + "@GRAD", uniq, inv, shards))
 
-        grad_names = [g for g, _ in self._sends]
+        grad_names = sorted({g for g, *_ in self._sends})
         sparse_fetch = [g for _, g, *_ in dist_ctx]
         outs = self.exe.run(
             self.program, feed=feed,
@@ -640,8 +659,11 @@ class DistTrainer:
             scope=self.scope)
         n_fetch = len(fetch_list)
         grads = dict(zip(grad_names + sparse_fetch, outs[n_fetch:]))
-        for gname, ep in self._sends:
-            self.client.send_var(ep, gname, grads[gname])
+        for gname, ep, wire, rows in self._sends:
+            arr = np.asarray(grads[gname])
+            if rows is not None:
+                arr = arr[rows[0]:rows[1]]
+            self.client.send_var(ep, wire, arr)
         # -- sparse grads back to the shard owners, merged per unique id --
         for wname, gname, uniq, inv, shards in dist_ctx:
             vals = np.asarray(grads[gname])
@@ -654,8 +676,7 @@ class DistTrainer:
                 self.client.send_sparse(ep, wname, uniq[m] - start,
                                         merged[m])
         self.client.batch_barrier()
-        for pname, ep in self._recvs:
-            self.scope.set(pname, self.client.get_var(ep, pname))
+        self._recv_all()
         return outs[:n_fetch]
 
     def save_checkpoint(self, dirname):

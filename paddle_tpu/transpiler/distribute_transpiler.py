@@ -58,6 +58,7 @@ class DistributeTranspiler:
         self.config = config or DistributeTranspilerConfig()
         self._mode = None
         self._param_to_ep = {}
+        self._param_blocks = {}
 
     # -- entry point -------------------------------------------------------
     def transpile(self, trainer_id, program=None, pservers="", trainers=1,
@@ -103,11 +104,65 @@ class DistributeTranspiler:
         dispatcher = (self.config.split_method or RoundRobin)(
             self.pserver_endpoints)
         params = [
-            p.name for p in self.origin_program.all_parameters()
+            p for p in self.origin_program.all_parameters()
             if p.name not in self._dist_tables
         ]
-        eps = dispatcher.dispatch(params)
-        self._param_to_ep = dict(zip(params, eps))
+        # slice_var_up (reference: distribute_transpiler.py slice_variable
+        # :130-152): split each param into >=min_block_size-element blocks
+        # aligned on dim 0, round-robin the BLOCKS over pservers so one
+        # big embedding doesn't pin a single server. self._param_blocks:
+        # pname -> [(block_name, row_start, row_end, endpoint)], only for
+        # params actually split (whole-var params stay in _param_to_ep).
+        self._param_blocks = {}
+        dispatch_units = []      # (pname, block_name_or_None, rows)
+        for p in params:
+            blocks = self._slice_rows(p)
+            if blocks is None:
+                dispatch_units.append((p.name, None, None))
+            else:
+                for bi, (r0, r1) in enumerate(blocks):
+                    dispatch_units.append(
+                        (p.name, "%s.block%d" % (p.name, bi), (r0, r1)))
+        eps = dispatcher.dispatch(dispatch_units)
+        self._param_to_ep = {}
+        for (pname, bname, rows), ep in zip(dispatch_units, eps):
+            if bname is None:
+                self._param_to_ep[pname] = ep
+            else:
+                self._param_blocks.setdefault(pname, []).append(
+                    (bname, rows[0], rows[1], ep))
+
+    def _slice_rows(self, param):
+        """Row ranges per block, or None when the param stays whole
+        (reference slice_variable's numel/min_block_size formula, dim-0
+        aligned)."""
+        import math
+
+        if not self.config.slice_var_up:
+            return None
+        shape = list(param.shape or [])
+        if len(shape) == 0:
+            return None
+        numel = 1
+        for d in shape:
+            numel *= int(d)
+        slice_count = len(self.pserver_endpoints)
+        max_count = max(int(numel // float(self.config.min_block_size)), 1)
+        split_count = min(max_count, slice_count)
+        if split_count <= 1:
+            return None
+        dim1 = max(numel // int(shape[0]), 1)
+        block_size = int(math.ceil(numel / float(split_count)))
+        remains = block_size % dim1
+        if remains != 0:
+            block_size += dim1 - remains
+        rows_per = block_size // dim1
+        out = []
+        r = 0
+        while r < int(shape[0]):
+            out.append((r, min(r + rows_per, int(shape[0]))))
+            r += rows_per
+        return out if len(out) > 1 else None
 
     def _shard_ranges(self, vocab):
         """Contiguous row ranges per pserver (reference splits by blocks via
@@ -150,7 +205,7 @@ class DistributeTranspiler:
         the table; DistTrainer does the prefetch/sparse-send RPC)."""
         trainer = self.origin_program.clone()
         block = trainer.desc.global_block()
-        remote_params = set(self._param_to_ep)
+        remote_params = set(self._param_to_ep) | set(self._param_blocks)
         new_ops = []
         sent = set()
         # per-lookup prefetch vars: a table looked up twice (shared-vocab
@@ -165,11 +220,23 @@ class DistributeTranspiler:
                 pname = owned[0]
                 if pname not in sent:
                     sent.add(pname)
-                    new_ops.append(_marker_op(
-                        "send", {"X": [pname + "@GRAD"]},
-                        {"Out": []},
-                        {"endpoints": [self._param_to_ep[pname]],
-                         OP_ROLE_KEY: OpRole.RPC}))
+                    if pname in self._param_blocks:
+                        # one send per block: the trainer slices the grad
+                        # rows (reference: send_op splitting VarBlocks)
+                        for bname, r0, r1, ep in self._param_blocks[pname]:
+                            new_ops.append(_marker_op(
+                                "send", {"X": [pname + "@GRAD"]},
+                                {"Out": []},
+                                {"endpoints": [ep],
+                                 "wire": bname + "@GRAD",
+                                 "rows": [r0, r1],
+                                 OP_ROLE_KEY: OpRole.RPC}))
+                    else:
+                        new_ops.append(_marker_op(
+                            "send", {"X": [pname + "@GRAD"]},
+                            {"Out": []},
+                            {"endpoints": [self._param_to_ep[pname]],
+                             OP_ROLE_KEY: OpRole.RPC}))
                 continue
             if self._dist_tables:
                 if (role & OpRole.Optimize
@@ -189,6 +256,12 @@ class DistributeTranspiler:
             new_ops.append(_marker_op(
                 "recv", {}, {"Out": [pname]},
                 {"endpoints": [ep], OP_ROLE_KEY: OpRole.RPC}))
+        for pname, blocks in self._param_blocks.items():
+            for bname, r0, r1, ep in blocks:
+                new_ops.append(_marker_op(
+                    "recv", {}, {"Out": [pname]},
+                    {"endpoints": [ep], "wire": bname,
+                     "rows": [r0, r1], OP_ROLE_KEY: OpRole.RPC}))
         # The rewritten grad ops no longer produce the table's @GRAD
         # contribution vars. Backward's dedup `sum` over them is dropped;
         # any OTHER surviving consumer (gradient clip / regularization on
@@ -324,6 +397,55 @@ class DistributeTranspiler:
             opt_blocks.append(sub.idx)
             block_grads.append(pname + "@GRAD")
 
+        # sliced params: one optimizer sub-block PER OWNED BLOCK, with the
+        # param/grad/state vars renamed to block-unique names and
+        # re-declared at the block's row count (reference:
+        # _create_vars_from_blocklist + the per-block optimize blocks of
+        # get_pserver_program:674; state slicing like _get_optimizer_input)
+        sliced_blocks_attr = []
+        for pname, blocks in self._param_blocks.items():
+            pd = src_block.find_var_recursive(pname)
+            pshape = list(pd.shape)
+            ops = self._ops_for_param(pname)
+            for bname, r0, r1, ep in blocks:
+                if ep != endpoint:
+                    continue
+                sub = pserver.desc.append_block(0)
+                _clone_ops_into(sub, ops, src_block, dst_block)
+                # rename every var the block WRITES (plus param + grad) so
+                # two blocks of one param on this server never collide;
+                # param-shaped renames also get the block's row count
+                written = {pname, pname + "@GRAD"}
+                for op in ops:
+                    written.update(op.output_arg_names())
+                suffix = bname[len(pname):]          # ".block%d"
+                rename = {n: n + suffix for n in written
+                          if src_block.find_var_recursive(n) is not None}
+                rename[pname] = bname
+                rename[pname + "@GRAD"] = bname + "@GRAD"
+                for op in sub.ops:
+                    for slot, names in op.inputs.items():
+                        op.inputs[slot] = [rename.get(n, n) for n in names]
+                    for slot, names in op.outputs.items():
+                        op.outputs[slot] = [rename.get(n, n)
+                                            for n in names]
+                import copy as _copy
+
+                for old, new in rename.items():
+                    vd = dst_block.vars.get(old) or \
+                        src_block.find_var_recursive(old)
+                    nd = _copy.deepcopy(vd)
+                    nd.name = new
+                    if nd.shape is not None and list(nd.shape) == pshape:
+                        nd.shape = [r1 - r0] + pshape[1:]
+                    dst_block.vars[new] = nd
+                sliced_blocks_attr.append({
+                    "param": pname, "name": bname, "rows": [r0, r1],
+                    "rename": dict(rename), "block": sub.idx,
+                })
+                opt_blocks.append(sub.idx)
+                block_grads.append(bname + "@GRAD")
+
         # Distributed lookup tables: every pserver owns one row-shard of
         # every table. The optimizer sub-block is the ORIGINAL optimizer op
         # fed by make_selected_rows assembling the wire (rows, values) into
@@ -372,6 +494,7 @@ class DistributeTranspiler:
              "Fanin": self.trainer_num,
              "sync_mode": self.sync_mode,
              "dist_tables": dist_tables_attr,
+             "sliced_blocks": sliced_blocks_attr,
              OP_ROLE_KEY: OpRole.RPC}))
         pserver._bump_version()
         pserver.blocks = pserver.blocks[:1]
@@ -394,7 +517,8 @@ class DistributeTranspiler:
         the sharding exists for (reference: get_startup_program:927 slices
         param init blocks the same way)."""
         base = startup_program or self.origin_startup
-        if base is None or not self._dist_tables or endpoint is None:
+        if base is None or endpoint is None or not (
+                self._dist_tables or self._param_blocks):
             return base
         if pserver_program is None:
             pserver_program = self.get_pserver_program(endpoint)
@@ -405,6 +529,46 @@ class DistributeTranspiler:
                 resize[n] = d["end"] - d["start"]
         startup = base.clone()
         block = startup.desc.global_block()
+        # sliced param blocks: clone each renamed var's init op at the
+        # block's row count and drop the full-var init (reference:
+        # get_startup_program:927 slicing param init blocks)
+        sliced = lns.attrs.get("sliced_blocks", [])
+        drop_full = set()
+        new_ops = []
+        for d in sliced:
+            r0, r1 = d["rows"]
+            pd = self.origin_program.desc.global_block() \
+                .find_var_recursive(d["param"])
+            pshape = list(pd.shape)
+            for old, new in d["rename"].items():
+                drop_full.add(old)
+                for op in block.ops:
+                    if old in op.output_arg_names():
+                        clone = _clone_op(op)
+                        for slot, names in clone.outputs.items():
+                            clone.outputs[slot] = [
+                                new if n == old else n for n in names]
+                        if "shape" in clone.attrs and list(
+                                clone.attrs["shape"]) == pshape:
+                            shp = list(clone.attrs["shape"])
+                            shp[0] = r1 - r0
+                            clone.attrs["shape"] = shp
+                        new_ops.append(clone)
+                        vd = block.vars.get(old)
+                        if vd is not None:
+                            import copy as _copy
+
+                            nd = _copy.deepcopy(vd)
+                            nd.name = new
+                            if (nd.shape is not None
+                                    and list(nd.shape) == pshape):
+                                nd.shape = [r1 - r0] + pshape[1:]
+                            block.vars[new] = nd
+        if sliced:
+            block.ops = [
+                op for op in block.ops
+                if not (set(op.output_arg_names()) & drop_full)
+            ] + new_ops
         for op in block.ops:
             for n in op.output_arg_names():
                 if n in resize and "shape" in op.attrs:
