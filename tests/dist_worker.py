@@ -1,7 +1,9 @@
-"""Subprocess worker for the real-process distributed test (the
+"""Subprocess worker for the real-process distributed tests (the
 reference's bar: tests/unittests/test_dist_base.py:213 spawns actual
-pserver/trainer processes, not threads). Role and topology come from
-env vars, results go to stdout as JSON."""
+pserver/trainer processes, not threads). Role and topology come from env
+vars; PADDLE_DIST_MODE selects sync (default), async (no-barrier apply
+loop), or lookup (distributed lookup table with prefetch + sparse
+pushback). Results go to stdout as JSON."""
 
 import json
 import os
@@ -52,6 +54,58 @@ def batches(n, batch, seed=0):
     return out
 
 
+VOCAB, DIM, FIELDS = 64, 4, 5
+
+
+def build_lookup(lr=0.2):
+    """Distributed-lookup-table model (mirrors
+    tests/test_dist_lookup_table.py's, so the subprocess run exercises
+    the same prefetch + sparse-pushback protocol under real process
+    isolation)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[FIELDS],
+                                dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[VOCAB, DIM], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="emb_w"))
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(input=pooled, size=4,
+                               param_attr=fluid.ParamAttr(name="fc_w"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    init = {
+        "emb_w": np.linspace(-0.5, 0.5, VOCAB * DIM).astype(
+            np.float32).reshape(VOCAB, DIM),
+        "fc_w": np.linspace(0.2, -0.2, DIM * 4).astype(
+            np.float32).reshape(DIM, 4),
+    }
+    return main, startup, loss, init
+
+
+def lookup_batches(n, batch, seed=0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    W = rng.randn(VOCAB).astype(np.float32)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, VOCAB, (batch, FIELDS)).astype(np.int64)
+        yv = (np.stack([W[ids].sum(1), -W[ids].sum(1),
+                        W[ids].max(1), W[ids].min(1)], 1)
+              .argmax(1).astype(np.int64).reshape(-1, 1))
+        out.append({"ids": ids, "y": yv})
+    return out
+
+
 def main():
     os.environ.setdefault("XLA_FLAGS",
                           "--xla_force_host_platform_device_count=1")
@@ -68,18 +122,35 @@ def main():
     trainers = int(os.environ["PADDLE_TRAINERS"])
     trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     n_steps = int(os.environ.get("PADDLE_STEPS", "6"))
+    mode = os.environ.get("PADDLE_DIST_MODE", "sync")
 
-    main_prog, startup, loss, init = build()
+    if mode == "lookup":
+        main_prog, startup, loss, init = build_lookup()
+    else:
+        main_prog, startup, loss, init = build()
     t = fluid.DistributeTranspiler()
     t.transpile(trainer_id=trainer_id, program=main_prog, pservers=eps,
-                trainers=trainers, startup_program=startup)
+                trainers=trainers, sync_mode=(mode != "async"),
+                startup_program=startup)
 
     if role == "PSERVER":
         ep = os.environ["PADDLE_CURRENT_EP"]
-        srv = ParameterServer(t.get_pserver_program(ep), startup, ep,
+        ps_prog, ps_start = t.get_pserver_programs(ep)
+        srv = ParameterServer(ps_prog, ps_start or startup, ep,
                               fanin=trainers)
         for k, v in init.items():
+            if mode == "lookup" and k == "emb_w":
+                # the server owns only its shard rows
+                shard = [s for s in t._dist_tables["emb_w"]["shards"]
+                         if s[0] == ep]
+                if shard:
+                    srv.scope.set(k, v[shard[0][1]:shard[0][2]])
+                continue
             srv.scope.set(k, v)
+        if mode == "lookup":
+            # memory contract under real isolation: never the full table
+            held = srv.scope.get("emb_w")
+            assert held is None or np.asarray(held).shape[0] < VOCAB
         print("READY", flush=True)
         srv.serve_forever()
         # after shutdown, dump owned params for the test to compare
@@ -94,11 +165,18 @@ def main():
     trainer.pull_params()
     half = 16
     losses = []
-    for b in batches(n_steps, 2 * half):
-        sl = slice(trainer_id * half, (trainer_id + 1) * half)
-        (l,) = trainer.run({"x": b["x"][sl], "y": b["y"][sl]},
-                           [loss.name])
-        losses.append(float(np.asarray(l)))
+    if mode == "lookup":
+        for b in lookup_batches(n_steps, 2 * half):
+            sl = slice(trainer_id * half, (trainer_id + 1) * half)
+            (l,) = trainer.run({"ids": b["ids"][sl], "y": b["y"][sl]},
+                               [loss.name])
+            losses.append(float(np.asarray(l)))
+    else:
+        for b in batches(n_steps, 2 * half):
+            sl = slice(trainer_id * half, (trainer_id + 1) * half)
+            (l,) = trainer.run({"x": b["x"][sl], "y": b["y"][sl]},
+                               [loss.name])
+            losses.append(float(np.asarray(l)))
     trainer.close()
     print("LOSSES " + json.dumps(losses), flush=True)
 
