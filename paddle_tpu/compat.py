@@ -322,3 +322,211 @@ def load_reference_inference_model(dirname, executor, scope=None,
     program._is_test = True
     fetch_vars = [program.global_block().vars[n] for n in fetch_names]
     return program, feed_names, fetch_vars
+
+
+# -- framework.proto ENCODING (export) --------------------------------------
+#
+# The write side of the same schema (reference: framework.proto:24-188):
+# emits proto2 wire format the reference's C++ protobuf parser accepts, so
+# repo-saved models load in reference tooling. Scalars use the schema's
+# field numbers mirrored from the decoder tables above.
+
+def _w_varint(v):
+    v &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _w_tag(field, wt):
+    return _w_varint((field << 3) | wt)
+
+
+def _w_len(field, payload):
+    return _w_tag(field, 2) + _w_varint(len(payload)) + payload
+
+
+def _w_int(field, v):
+    return _w_tag(field, 0) + _w_varint(int(v))
+
+
+def _w_f32(field, v):
+    return _w_tag(field, 5) + struct.pack("<f", float(v))
+
+
+def _w_str(field, s):
+    return _w_len(field, s.encode("utf-8"))
+
+
+def _encode_attr(name, value):
+    """One OpDesc.Attr message, or None for non-representable values
+    (engine-internal dict/None attrs are dropped from the export)."""
+    head = _w_str(1, name)
+    if isinstance(value, np.bool_):
+        value = bool(value)
+    elif isinstance(value, np.integer):
+        value = int(value)
+    elif isinstance(value, np.floating):
+        value = float(value)
+    if isinstance(value, bool):
+        return head + _w_int(2, 6) + _w_int(10, int(value))
+    if isinstance(value, int):
+        if name == "sub_block":
+            return head + _w_int(2, 8) + _w_int(12, value)
+        if -(1 << 31) <= value < (1 << 31):
+            return head + _w_int(2, 0) + _w_int(3, value)
+        return head + _w_int(2, 9) + _w_int(13, value)
+    if isinstance(value, float):
+        return head + _w_int(2, 1) + _w_f32(4, value)
+    if isinstance(value, str):
+        return head + _w_int(2, 2) + _w_str(5, value)
+    if isinstance(value, (list, tuple)):
+        vals = list(value)
+        if all(isinstance(v, bool) for v in vals) and vals:
+            return head + _w_int(2, 7) + b"".join(
+                _w_int(11, int(v)) for v in vals)
+        if all(isinstance(v, (int, np.integer)) for v in vals):
+            if all(-(1 << 31) <= int(v) < (1 << 31) for v in vals):
+                return head + _w_int(2, 3) + b"".join(
+                    _w_int(6, int(v)) for v in vals)
+            return head + _w_int(2, 11) + b"".join(
+                _w_int(15, int(v)) for v in vals)
+        if all(isinstance(v, (float, np.floating)) for v in vals):
+            return head + _w_int(2, 4) + b"".join(
+                _w_f32(7, v) for v in vals)
+        if all(isinstance(v, str) for v in vals):
+            return head + _w_int(2, 5) + b"".join(
+                _w_str(8, v) for v in vals)
+    return None
+
+
+def _encode_op(op):
+    out = bytearray()
+
+    def slots(field, mapping):
+        for slot, names in mapping.items():
+            var = _w_str(1, slot) + b"".join(_w_str(2, n) for n in names)
+            out.extend(_w_len(field, var))
+
+    slots(1, op.inputs)
+    slots(2, op.outputs)
+    out.extend(_w_str(3, op.type))
+    for name, value in sorted(op.attrs.items()):
+        enc = _encode_attr(name, value)
+        if enc is not None:
+            out.extend(_w_len(4, enc))
+    return bytes(out)
+
+
+def _encode_tensor_desc(dtype, dims):
+    out = _w_int(1, int(dtype))
+    for d in (dims or []):
+        out += _w_int(2, -1 if d in (None, -1) else int(d))
+    return out
+
+
+def _encode_var(vd):
+    vtype = vd.type
+    tdesc = _encode_tensor_desc(
+        vd.dtype if vd.dtype is not None else VarType.FP32, vd.shape)
+    if vtype == VarType.SELECTED_ROWS:
+        type_msg = _w_int(1, int(vtype)) + _w_len(2, tdesc)
+    elif vtype == VarType.LOD_TENSOR_ARRAY:
+        sub = _w_len(1, tdesc) + _w_int(2, int(vd.lod_level or 0))
+        type_msg = _w_int(1, int(vtype)) + _w_len(4, sub)
+    elif vtype == VarType.LOD_TENSOR:
+        sub = _w_len(1, tdesc) + _w_int(2, int(vd.lod_level or 0))
+        type_msg = _w_int(1, int(vtype)) + _w_len(3, sub)
+    else:
+        # RAW / READER / marker types carry no tensor desc
+        type_msg = _w_int(1, int(vtype))
+    return (_w_str(1, vd.name) + _w_len(2, type_msg)
+            + _w_int(3, int(bool(vd.persistable))))
+
+
+def serialize_program_desc(prog):
+    """ProgramDescData -> binary framework.proto ProgramDesc bytes."""
+    out = bytearray()
+    for b in prog.blocks:
+        bb = bytearray()
+        bb.extend(_w_int(1, b.idx))
+        bb.extend(_w_int(2, max(b.parent_idx, 0) if b.idx else 0))
+        for vd in b.vars.values():
+            bb.extend(_w_len(3, _encode_var(vd)))
+        for op in b.ops:
+            bb.extend(_w_len(4, _encode_op(op)))
+        fwd = getattr(b, "forward_block_idx", -1)
+        bb.extend(_w_tag(5, 0) + _w_varint(fwd))
+        out.extend(_w_len(1, bytes(bb)))
+    out.extend(_w_len(2, _w_int(1, getattr(prog, "version", 0))))
+    return bytes(out)
+
+
+def save_reference_var(arr, path, lod_level=0):
+    """Write one tensor in the reference save-op stream format
+    (lod_tensor.cc SerializeToStream + tensor_util.cc TensorToStream) so
+    reference load ops can read it."""
+    from paddle_tpu.core.types import convert_np_dtype_to_dtype_
+
+    arr = np.ascontiguousarray(arr)
+    dtype = convert_np_dtype_to_dtype_(arr.dtype)
+    proto = _encode_tensor_desc(dtype, list(arr.shape))
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", 0))          # lod stream version
+        f.write(struct.pack("<Q", int(lod_level)))
+        f.write(struct.pack("<I", 0))          # tensor version
+        f.write(struct.pack("<i", len(proto)))
+        f.write(proto)
+        f.write(arr.tobytes())
+
+
+def save_reference_inference_model(dirname, feeded_var_names, target_vars,
+                                   executor, main_program=None,
+                                   model_filename="__model__", scope=None):
+    """Export an inference model in the REFERENCE on-disk format — binary
+    framework.proto `__model__` with feed/fetch ops plus one reference
+    tensor-stream file per persistable var — loadable by both reference
+    tooling and load_reference_inference_model above (reference: io.py
+    save_inference_model + save_persistables)."""
+    import paddle_tpu.io as ptio
+    from paddle_tpu.executor import global_scope
+    from paddle_tpu.framework import default_main_program
+
+    main_program = main_program or default_main_program()
+    scope = scope if scope is not None else global_scope()
+    fetch_names = [v.name for v in target_vars]
+    pruned = ptio._prune_for_inference(main_program, feeded_var_names,
+                                       fetch_names)
+    gb = pruned.desc.global_block()
+    # feed/fetch ops as the reference prepends/appends them
+    # (io.py prepend_feed_ops/append_fetch_ops)
+    gb.vars["feed"] = VarDescData("feed", type=VarType.FEED_MINIBATCH,
+                                  persistable=True)
+    gb.vars["fetch"] = VarDescData("fetch", type=VarType.FETCH_LIST,
+                                   persistable=True)
+    feed_ops = [
+        OpDesc("feed", {"X": ["feed"]}, {"Out": [n]}, {"col": i})
+        for i, n in enumerate(feeded_var_names)
+    ]
+    fetch_ops = [
+        OpDesc("fetch", {"X": [n]}, {"Out": ["fetch"]}, {"col": i})
+        for i, n in enumerate(fetch_names)
+    ]
+    gb.ops = feed_ops + gb.ops + fetch_ops
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        f.write(serialize_program_desc(pruned.desc))
+    for name, vd in gb.vars.items():
+        if not vd.persistable or name in ("feed", "fetch"):
+            continue
+        val = scope.get(name)
+        if val is None:
+            continue
+        save_reference_var(np.asarray(val), os.path.join(dirname, name))
+    return fetch_names

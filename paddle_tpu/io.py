@@ -135,7 +135,19 @@ def _prune_for_inference(program, feed_names, fetch_names):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None, export_for_deployment=True):
+                         params_filename=None, export_for_deployment=True,
+                         export_format="native"):
+    """``export_format="reference"`` writes the reference's on-disk format
+    instead — binary framework.proto ``__model__`` + per-var tensor
+    streams — so reference tooling can load repo models (reference:
+    framework.proto:24-188, lod_tensor.cc SerializeToStream)."""
+    if export_format == "reference":
+        from paddle_tpu import compat
+
+        return compat.save_reference_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program=main_program,
+            model_filename=model_filename or "__model__")
     main_program = main_program or default_main_program()
     fetch_names = [v.name for v in target_vars]
     pruned = _prune_for_inference(main_program, feeded_var_names, fetch_names)
