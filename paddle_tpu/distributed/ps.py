@@ -117,25 +117,60 @@ def _send_msg(sock, obj):
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
-def _recv_msg(sock):
-    head = _recv_exact(sock, 8)
+def _recv_msg(sock, idle_ok=False):
+    """Receive one frame. With ``idle_ok`` (server waiting for a client's
+    NEXT request) the wait for the frame header is unbounded — idle
+    connections are normal; the deadline still bounds the frame BODY so a
+    half-sent frame cannot hang a handler forever."""
+    head = _recv_exact(sock, 8, deadline=None if idle_ok
+                       else rpc_deadline_seconds())
     if head is None:
         return None
     (n,) = struct.unpack("<Q", head)
-    body = _recv_exact(sock, n)
+    body = _recv_exact(sock, n, deadline=rpc_deadline_seconds())
     if body is None:
         return None
     return _decode_msg(body)
 
 
-def _recv_exact(sock, n):
+class RpcDeadlineError(OSError):
+    """A peer failed to answer within PADDLE_TPU_RPC_DEADLINE_MS
+    (reference: FLAGS_rpc_deadline + the completion-queue timeouts of
+    operators/distributed/grpc/grpc_client.cc:64 — a hung peer must fail
+    the RPC, not block the trainer forever)."""
+
+
+def rpc_deadline_seconds():
+    import os
+
+    ms = float(os.environ.get("PADDLE_TPU_RPC_DEADLINE_MS", "180000"))
+    return None if ms <= 0 else ms / 1000.0
+
+
+def _recv_exact(sock, n, deadline=None):
+    import socket as _socket
+
+    prev = sock.gettimeout()
+    sock.settimeout(deadline)
     buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
+    try:
+        while len(buf) < n:
+            try:
+                chunk = sock.recv(n - len(buf))
+            except _socket.timeout:
+                raise RpcDeadlineError(
+                    "RPC deadline exceeded (%.0f ms) waiting for peer %s"
+                    % ((deadline or 0) * 1000.0,
+                       sock.getpeername() if sock.fileno() >= 0 else "?"))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:
+            pass
 
 
 # -- server ----------------------------------------------------------------
@@ -261,7 +296,7 @@ class ParameterServer:
 
     def _handle_loop(self, conn):
         while True:
-            msg = _recv_msg(conn)
+            msg = _recv_msg(conn, idle_ok=True)
             if msg is None:
                 return
             kind = msg[0]
@@ -494,7 +529,10 @@ class PSClient:
         for s in self._socks.values():
             _send_msg(s, ("batch_barrier",))
         for s in self._socks.values():
-            assert _recv_msg(s)[0] == "ok"
+            # barrier completion waits on the SLOWEST peer trainer (a
+            # straggler's first-step compile can exceed any RPC deadline)
+            # — unbounded like the reference's sync barrier
+            assert _recv_msg(s, idle_ok=True)[0] == "ok"
 
     def get_var(self, ep, name):
         _send_msg(self._socks[ep], ("get", name))

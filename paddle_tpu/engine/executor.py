@@ -32,10 +32,17 @@ class Engine:
     """One engine per Executor; owns the executable cache."""
 
     def __init__(self, place=None):
+        import collections
         import os
 
         self.place = place
-        self._cache = {}
+        # LRU-bounded executable cache (reference: Executor's program cache
+        # with explicit drop semantics, executor.py:552 + the bounded
+        # kernel caches of execution_strategy.h) — a long-lived serving
+        # process with drifting shapes must not leak compiled executables.
+        self._cache = collections.OrderedDict()
+        self._cache_capacity = int(os.environ.get(
+            "PADDLE_TPU_EXECUTABLE_CACHE_SIZE", "128"))
         self._run_counter = 0
         # Debug guard (reference: FLAGS_check_nan_inf,
         # framework/operator.cc:972-982): verify every fetch and persisted
@@ -105,6 +112,10 @@ class Engine:
                 accumulate_steps=accumulate_steps,
             )
             self._cache[key] = compiled
+            while len(self._cache) > self._cache_capacity:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]

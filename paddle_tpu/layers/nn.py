@@ -2352,7 +2352,8 @@ def similarity_focus(input, axis, indexes, name=None):
 
 
 def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
-                    dropout_rate=0.0, name=None):
+                    dropout_rate=0.0, name=None, sequence_parallel=False,
+                    sp_axis="sp", sp_batch_axis=None):
     """Whole-attention fusion over [B, H, T, D] inputs: the Pallas
     flash-attention kernel on TPU, plain-XLA composition elsewhere.
 
@@ -2371,6 +2372,14 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
     if seq_lens is not None:
         inputs["SeqLens"] = [seq_lens]
     attrs = {"causal": bool(causal), "dropout_rate": float(dropout_rate)}
+    if sequence_parallel:
+        # ring attention over the mesh's sequence-parallel axis
+        # (parallel/ring_attention.py) — requires T divisible by the
+        # sp axis size and no dropout/seq_lens
+        attrs["sequence_parallel"] = True
+        attrs["sp_axis"] = sp_axis
+        if sp_batch_axis:
+            attrs["sp_batch_axis"] = sp_batch_axis
     if scale is not None:
         attrs["scale"] = float(scale)
     helper.append_op(type="fused_attention", inputs=inputs,

@@ -31,14 +31,18 @@ def positional_encoding_table(max_len, d_model):
 
 def multi_head_attention(q_in, k_in, v_in, d_model, n_heads, dropout_rate,
                          mask=None, seq_lens=None, causal=False,
-                         is_train=True, name=None):
+                         is_train=True, name=None,
+                         sequence_parallel=False, sp_axis="sp"):
     """Scaled dot-product attention with head split/merge
     (reference: dist_transformer.py multi_head_attention).
 
     With ``mask=None`` the core is a single ``fused_attention`` op
     (Pallas flash kernels on TPU): key padding via ``seq_lens``, causal
     via the flag, attention dropout in-kernel. A dense additive ``mask``
-    forces the unfused composition."""
+    forces the unfused composition. ``sequence_parallel=True`` shards the
+    sequence axis over the mesh's ``sp_axis`` and runs exact ring
+    attention (parallel/ring_attention.py) — the long-context path; it
+    requires dropout 0 and no seq_lens/mask."""
     d_head = d_model // n_heads
     q = fluid.layers.fc(input=q_in, size=d_model, num_flatten_dims=2,
                         bias_attr=False)
@@ -52,7 +56,22 @@ def multi_head_attention(q_in, k_in, v_in, d_model, n_heads, dropout_rate,
         return fluid.layers.transpose(x, perm=[0, 2, 1, 3])  # [B,H,T,dh]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    if mask is None:
+    if sequence_parallel:
+        if mask is not None:
+            raise ValueError(
+                "sequence_parallel attention takes no dense mask")
+        if seq_lens is not None:
+            raise ValueError(
+                "sequence_parallel attention does not support seq_lens; "
+                "pad to full length")
+        if is_train and dropout_rate > 0:
+            raise ValueError(
+                "sequence_parallel attention does not support attention "
+                "dropout; set dropout_rate=0")
+        ctx = _fused_attention_layer(
+            q, k, v, causal=causal, scale=d_head ** -0.5,
+            dropout_rate=0.0, sequence_parallel=True, sp_axis=sp_axis)
+    elif mask is None:
         ctx = _fused_attention_layer(
             q, k, v, causal=causal, scale=d_head ** -0.5,
             seq_lens=seq_lens,

@@ -325,6 +325,27 @@ def fused_attention_op(ctx, ins, attrs):
         seed = jax.random.randint(ctx.rng(), (), 0, jnp.iinfo(jnp.int32).max)
     else:
         seed = 0
+    if bool(attrs.get("sequence_parallel", False)):
+        # long-sequence path: exact attention with the T axis sharded over
+        # the mesh's sp axis via ppermute ring (parallel/ring_attention.py)
+        # — the framework-level entry to sequence/context parallelism
+        if rate > 0.0:
+            raise NotImplementedError(
+                "fused_attention: dropout inside the ring-attention path "
+                "is not supported; set dropout_rate=0 when "
+                "sequence_parallel=True")
+        if lens is not None:
+            raise NotImplementedError(
+                "fused_attention: seq_lens masks are not supported with "
+                "sequence_parallel=True (pad to full length instead)")
+        from paddle_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(
+            q, k, v, axis_name=str(attrs.get("sp_axis", "sp")),
+            causal=bool(attrs.get("causal", False)),
+            scale=attrs.get("scale", None),
+            batch_axis=attrs.get("sp_batch_axis", None) or None)
+        return {"Out": [out]}
     out = _fa(q, k, v,
               causal=bool(attrs.get("causal", False)),
               scale=attrs.get("scale", None),

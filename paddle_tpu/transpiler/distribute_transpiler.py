@@ -403,6 +403,7 @@ class DistributeTranspiler:
         # _create_vars_from_blocklist + the per-block optimize blocks of
         # get_pserver_program:674; state slicing like _get_optimizer_input)
         sliced_blocks_attr = []
+        prune_full = set()   # full-shape originals superseded by renames
         for pname, blocks in self._param_blocks.items():
             pd = src_block.find_var_recursive(pname)
             pshape = list(pd.shape)
@@ -445,6 +446,13 @@ class DistributeTranspiler:
                 })
                 opt_blocks.append(sub.idx)
                 block_grads.append(bname + "@GRAD")
+                prune_full.update(old for old, new in rename.items()
+                                  if old != new)
+        # drop the full-shape descs _clone_ops_into copied — no op on this
+        # server references them after renaming, and a declared full-size
+        # param would contradict the never-holds-the-whole-var contract
+        for old in prune_full:
+            dst_block.vars.pop(old, None)
 
         # Distributed lookup tables: every pserver owns one row-shard of
         # every table. The optimizer sub-block is the ORIGINAL optimizer op

@@ -6,13 +6,25 @@ with a stateful optimizer (Momentum velocity is param-shaped, so its
 state must slice and rename per block) matches local training exactly.
 """
 
+import socket
+
 import numpy as np
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.distributed.ps import DistTrainer, ParameterServer
 from paddle_tpu.framework import Program, program_guard
 
-ENDPOINTS = "127.0.0.1:62101,127.0.0.1:62102"
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _endpoints():
+    return "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
 
 
 def _build():
@@ -59,8 +71,9 @@ def test_slice_var_up_parity():
     cfg = fluid.DistributeTranspilerConfig()
     cfg.slice_var_up = True
     cfg.min_block_size = 8192
+    eps = _endpoints()
     t = fluid.DistributeTranspiler(config=cfg)
-    t.transpile(0, program=main, pservers=ENDPOINTS, trainers=1,
+    t.transpile(0, program=main, pservers=eps, trainers=1,
                 startup_program=startup)
 
     assert "big_w" in t._param_blocks, "big param must be sliced"
@@ -73,7 +86,7 @@ def test_slice_var_up_parity():
 
     servers = []
     try:
-        for ep in ENDPOINTS.split(","):
+        for ep in eps.split(","):
             ps_prog, ps_start = t.get_pserver_programs(ep)
             s = ParameterServer(ps_prog, ps_start, ep, fanin=1)
             s.start()
@@ -100,6 +113,8 @@ def test_slice_var_up_parity():
             with s._lock:
                 s._stop = True
                 s._lock.notify_all()
+        for s in servers:
+            s._sock.close()
 
     np.testing.assert_allclose(l_dist, l_local, rtol=1e-5)
 
@@ -109,7 +124,7 @@ def test_slice_var_up_off_keeps_whole_vars():
     cfg = fluid.DistributeTranspilerConfig()
     cfg.slice_var_up = False
     t = fluid.DistributeTranspiler(config=cfg)
-    t.transpile(0, program=main, pservers=ENDPOINTS, trainers=1,
+    t.transpile(0, program=main, pservers=_endpoints(), trainers=1,
                 startup_program=startup)
     assert not t._param_blocks
     assert set(t._param_to_ep) >= {"big_w", "small_w"}
