@@ -73,6 +73,119 @@ class AsyncExecutor:
         self.executor = fluid.Executor(place)
         self.scope = fluid.global_scope()
 
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        """(reference: async_executor.py get_instance — process
+        singleton for the distributed mode)."""
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def config_distributed_nodes(self):
+        """Read the cluster topology from the launcher env (reference:
+        async_executor.py config_distributed_nodes over MPI ranks; here
+        the PADDLE_* env contract of distributed/launch.py)."""
+        import os
+
+        self._dist_role = os.environ.get("PADDLE_ROLE",
+                                         os.environ.get(
+                                             "TRAINING_ROLE", "TRAINER"))
+        self._dist_eps = [e for e in os.environ.get(
+            "PADDLE_PSERVER_EPS", "").split(",") if e]
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._trainers = int(os.environ.get("PADDLE_TRAINERS", "1"))
+        return {"role": self._dist_role, "pservers": self._dist_eps,
+                "trainer_id": self._trainer_id,
+                "trainers": self._trainers}
+
+    def init_server(self, dist_desc=None):
+        """Start this node's parameter server (reference:
+        async_executor.py init_server over the MPI pserver).
+        ``dist_desc``: a (pserver_program, startup_program) pair, or a
+        transpiled DistributeTranspiler (the programs are derived for
+        this node's PADDLE_CURRENT_EP)."""
+        import os
+
+        from paddle_tpu.distributed.ps import ParameterServer
+        from paddle_tpu.transpiler import DistributeTranspiler
+
+        ep = os.environ["PADDLE_CURRENT_EP"]
+        if isinstance(dist_desc, DistributeTranspiler):
+            prog, start = dist_desc.get_pserver_programs(ep)
+        elif isinstance(dist_desc, (tuple, list)) and len(dist_desc) == 2:
+            prog, start = dist_desc
+        else:
+            raise ValueError(
+                "init_server needs dist_desc = (pserver_program, "
+                "startup_program) or a transpiled DistributeTranspiler")
+        self._server = ParameterServer(
+            prog, start, ep, fanin=getattr(self, "_trainers", 1))
+        self._server.start()
+        return self._server
+
+    def init_worker(self, dist_desc=None, startup_program=None):
+        """Connect this trainer to the pservers (reference:
+        async_executor.py init_worker)."""
+        from paddle_tpu.distributed.ps import PSClient
+
+        self._client = PSClient(self._dist_eps)
+        if startup_program is not None:
+            self.run_startup_program(startup_program)
+        return self._client
+
+    def init_model(self, program=None):
+        """Push this worker's initialized params to the servers
+        (reference: async_executor.py init_model)."""
+        import numpy as np
+
+        program = program or getattr(self, "_program", None)
+        if program is None:
+            raise ValueError("init_model needs a program")
+        for p in program.all_parameters():
+            val = self.scope.get(p.name)
+            if val is None:
+                continue
+            for ep in self._dist_eps:
+                self._client.send_var(ep, p.name, np.asarray(val))
+
+    def save_model(self, save_path, program=None):
+        """(reference: async_executor.py save_model) — persistables to
+        disk via fluid.io."""
+        import paddle_tpu.io as ptio
+
+        program = program or getattr(self, "_program", None)
+        ptio.save_persistables(self.executor, save_path, program)
+
+    def download_data(self, afs_path, local_path, fs_default_name,
+                      ugi, file_cnt=None, hadoop_home="$HADOOP_HOME",
+                      process_num=12):
+        """(reference: async_executor.py download_data over HDFS)."""
+        from paddle_tpu.contrib.utils import HDFSClient, multi_download
+
+        client = HDFSClient(hadoop_home, {
+            "fs.default.name": fs_default_name,
+            "hadoop.job.ugi": ugi,
+        })
+        return multi_download(
+            client, afs_path, local_path,
+            getattr(self, "_trainer_id", 0),
+            getattr(self, "_trainers", 1),
+            multi_processes=process_num)
+
+    def stop(self):
+        """Close the distributed session (reference:
+        async_executor.py stop)."""
+        client = getattr(self, "_client", None)
+        if client is not None:
+            client.send_complete()
+        server = getattr(self, "_server", None)
+        if server is not None:
+            with server._lock:
+                server._stop = True
+                server._lock.notify_all()
+
     def run_startup_program(self, program, scope=None):
         self.executor.run(program, scope=scope or self.scope)
 

@@ -65,6 +65,15 @@ class CompiledProgram:
         self._shard_rules = None
         self._data_axes = ("dp",)
 
+    def with_inference_optimize(self, config):
+        """(reference: compiler.py with_inference_optimize) — marks the
+        program for inference; BN folding etc. happen via
+        InferenceTranspiler/AnalysisConfig (inference.py); XLA does the
+        operator fusion the reference's analysis passes hand-schedule."""
+        self._program = self._program.clone(for_test=True)
+        self._is_inference = True
+        return self
+
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
                            places=None):

@@ -1,8 +1,41 @@
-"""contrib: quantization (slim QAT + INT8 post-training calibration) —
-the fork's headline capability (reference:
-python/paddle/fluid/contrib/slim/quantization/quantization_pass.py and
-contrib/int8_inference/utility.py)."""
+"""contrib (reference: python/paddle/fluid/contrib/__init__.py) —
+quantization (slim QAT + INT8 calibration, the fork's headline), the
+decoder API, compression framework, utils, memory/op statistics."""
 
 from paddle_tpu.contrib import slim  # noqa: F401
 from paddle_tpu.contrib import int8_inference  # noqa: F401
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
+from paddle_tpu.contrib import decoder  # noqa: F401
+from paddle_tpu.contrib.decoder import (  # noqa: F401
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+from paddle_tpu.contrib import memory_usage_calc  # noqa: F401
+from paddle_tpu.contrib.memory_usage_calc import memory_usage  # noqa: F401
+from paddle_tpu.contrib import op_frequence  # noqa: F401
+from paddle_tpu.contrib.op_frequence import op_freq_statistic  # noqa: F401
+from paddle_tpu.contrib import quantize  # noqa: F401
+from paddle_tpu.contrib.quantize import QuantizeTranspiler  # noqa: F401
+from paddle_tpu.contrib.int8_inference.utility import Calibrator  # noqa: F401
+from paddle_tpu.contrib import reader  # noqa: F401
+from paddle_tpu.contrib.slim.core import (  # noqa: F401
+    CompressPass,
+    ImitationGraph,
+    build_compressor,
+)
+from paddle_tpu.contrib.slim.prune import (  # noqa: F401
+    MagnitudePruner,
+    RatioPruner,
+    SensitivePruneStrategy,
+)
+from paddle_tpu.contrib import utils  # noqa: F401
+from paddle_tpu.contrib.utils import (  # noqa: F401
+    HDFSClient,
+    convert_dist_to_sparse_program,
+    load_persistables_for_increment,
+    load_persistables_for_inference,
+    multi_download,
+    multi_upload,
+)

@@ -1,0 +1,11 @@
+"""Decoder API (reference: python/paddle/fluid/contrib/decoder/)."""
+
+from paddle_tpu.contrib.decoder.beam_search_decoder import (  # noqa: F401
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]

@@ -16,14 +16,20 @@ class Calibrator:
     """Collects abs-max activation statistics by running the float program
     over calibration batches, then freezes an INT8 inference program."""
 
-    def __init__(self, program, scope, exe, feed_names, fetch_list,
-                 algo="abs_max"):
-        self.program = program
-        self.scope = scope
-        self.exe = exe
-        self.feed_names = feed_names
-        self.fetch_list = fetch_list
-        self.algo = algo
+    def __init__(self, *args, **kwargs):
+        # reference signature is (*args, **kwargs) (utility.py Calibrator)
+        names = ["program", "scope", "exe", "feed_names", "fetch_list",
+                 "algo"]
+        params = dict(zip(names, args))
+        params.update(kwargs)
+        self.program = params.get("program")
+        self.scope = params.get("scope")
+        self.exe = params.get("exe")
+        self.feed_names = params.get("feed_names")
+        self.fetch_list = params.get("fetch_list")
+        self.algo = params.get("algo", "abs_max")
+        self._sampled = []
+        self._frozen = None
 
     def calibrate_and_freeze(self, batches):
         """batches: iterable of feed dicts. Returns the INT8 program."""
@@ -46,3 +52,27 @@ class Calibrator:
             freeze = QuantizationFreezePass(self.scope)
             freeze.apply(self.program)
         return self.program
+
+    def sample_data(self, batches=None):
+        """Collect calibration batches (reference: utility.py
+        Calibrator.sample_data). Feed dicts accumulate until
+        save_int8_model runs the calibrate-and-freeze flow."""
+        if batches is not None:
+            self._sampled.extend(batches)
+        return len(self._sampled)
+
+    def save_int8_model(self, dirname=None):
+        """Run calibration over the sampled batches and freeze the INT8
+        program (reference: utility.py Calibrator.save_int8_model);
+        optionally save it via save_inference_model."""
+        self._frozen = self.calibrate_and_freeze(self._sampled)
+        if dirname is not None:
+            import paddle_tpu.io as ptio
+
+            fetch_vars = [
+                self.program.global_block().var(n)
+                if isinstance(n, str) else n for n in self.fetch_list]
+            ptio.save_inference_model(
+                dirname, list(self.feed_names), fetch_vars, self.exe,
+                main_program=self._frozen)
+        return self._frozen

@@ -1,0 +1,36 @@
+"""Train-memory estimation (reference:
+python/paddle/fluid/contrib/memory_usage_calc.py memory_usage:38 — sums
+var sizes with the -1 batch dim filled in and reports a low/high GB
+range)."""
+
+from paddle_tpu.core.types import convert_dtype_to_np
+
+__all__ = ["memory_usage"]
+
+DEBUG = False
+_GB = 1 << 30
+
+
+def memory_usage(program, batch_size):
+    """Estimated (lower, upper) memory in GB for one batch (the
+    reference's 0.70/1.15 uncertainty band)."""
+    import numpy as np
+
+    if program is None:
+        raise ValueError("The program cannot be None.")
+    if batch_size <= 0:
+        raise ValueError("The batch size must be positive.")
+    total = 0
+    for b in program.blocks:
+        for vd in b.desc.vars.values():
+            if vd.shape is None:
+                continue
+            numel = 1
+            for d in vd.shape:
+                numel *= batch_size if d in (-1, None) else int(d)
+            try:
+                itemsize = np.dtype(convert_dtype_to_np(vd.dtype)).itemsize
+            except Exception:
+                itemsize = 4
+            total += numel * itemsize
+    return total * 0.70 / _GB, total * 1.15 / _GB, "GB"

@@ -1,0 +1,26 @@
+"""Op frequency statistics (reference:
+python/paddle/fluid/contrib/op_frequence.py op_freq_statistic:24 —
+returns (unigram op counts, adjacent op-pair counts), sorted by
+frequency)."""
+
+from collections import OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    if program is None:
+        raise ValueError("The program cannot be None.")
+    uni, adj = {}, {}
+    for b in program.blocks:
+        ops = b.desc.ops
+        for i, op in enumerate(ops):
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if i + 1 < len(ops):
+                key = "%s->%s" % (op.type, ops[i + 1].type)
+                adj[key] = adj.get(key, 0) + 1
+    uni_sorted = OrderedDict(
+        sorted(uni.items(), key=lambda kv: kv[1], reverse=True))
+    adj_sorted = OrderedDict(
+        sorted(adj.items(), key=lambda kv: kv[1], reverse=True))
+    return uni_sorted, adj_sorted

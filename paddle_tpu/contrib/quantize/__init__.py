@@ -1,0 +1,5 @@
+from paddle_tpu.contrib.quantize.quantize_transpiler import (  # noqa: F401
+    QuantizeTranspiler,
+)
+
+__all__ = ["QuantizeTranspiler"]

@@ -1,0 +1,3 @@
+from paddle_tpu.contrib.reader import ctr_reader  # noqa: F401
+
+__all__ = []

@@ -1,1 +1,3 @@
 from paddle_tpu.contrib.slim import quantization  # noqa: F401
+from paddle_tpu.contrib.slim import core  # noqa: F401
+from paddle_tpu.contrib.slim import prune  # noqa: F401

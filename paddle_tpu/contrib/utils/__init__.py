@@ -1,0 +1,15 @@
+from paddle_tpu.contrib.utils.hdfs_utils import (  # noqa: F401
+    HDFSClient,
+    multi_download,
+    multi_upload,
+)
+from paddle_tpu.contrib.utils.lookup_table_utils import (  # noqa: F401
+    convert_dist_to_sparse_program,
+    load_persistables_for_increment,
+    load_persistables_for_inference,
+)
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference",
+           "convert_dist_to_sparse_program"]

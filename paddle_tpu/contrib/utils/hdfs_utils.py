@@ -1,0 +1,123 @@
+"""HDFS helpers (reference:
+python/paddle/fluid/contrib/utils/hdfs_utils.py — HDFSClient:32 wrapping
+the ``hadoop fs`` CLI, multi_download:386, multi_upload:450). Same CLI
+contract; fails with a clear error when no hadoop binary is present
+(this image has none)."""
+
+import os
+import subprocess
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+class HDFSClient:
+    def __init__(self, hadoop_home, configs):
+        self.pre_commands = []
+        hadoop_bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self.pre_commands.append(hadoop_bin)
+        self.pre_commands.append("fs")
+        for k, v in (configs or {}).items():
+            self.pre_commands.extend(["-D", "%s=%s" % (k, v)])
+
+    def _run(self, commands, retry=1):
+        cmd = self.pre_commands + commands
+        last = None
+        for _ in range(max(retry, 1)):
+            try:
+                proc = subprocess.run(
+                    cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, timeout=600)
+                if proc.returncode == 0:
+                    return proc.stdout
+                last = proc.stderr
+            except FileNotFoundError:
+                raise RuntimeError(
+                    "hadoop binary not found at %r — HDFSClient needs a "
+                    "hadoop installation" % self.pre_commands[0])
+        raise RuntimeError("hadoop command %s failed: %s" % (commands,
+                                                             last))
+
+    def is_exist(self, hdfs_path):
+        try:
+            self._run(["-test", "-e", hdfs_path])
+            return True
+        except RuntimeError:
+            return False
+
+    def is_dir(self, hdfs_path):
+        try:
+            self._run(["-test", "-d", hdfs_path])
+            return True
+        except RuntimeError:
+            return False
+
+    def delete(self, hdfs_path):
+        return self._run(["-rm", "-r", hdfs_path])
+
+    def rename(self, hdfs_src_path, hdfs_dst_path, overwrite=False):
+        if overwrite and self.is_exist(hdfs_dst_path):
+            self.delete(hdfs_dst_path)
+        return self._run(["-mv", hdfs_src_path, hdfs_dst_path])
+
+    def makedirs(self, hdfs_path):
+        return self._run(["-mkdir", "-p", hdfs_path])
+
+    def make_local_dirs(self, local_path):
+        os.makedirs(local_path, exist_ok=True)
+
+    def ls(self, hdfs_path):
+        out = self._run(["-ls", hdfs_path])
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+
+    def lsr(self, hdfs_path, only_file=True, sort=True):
+        out = self._run(["-ls", "-R", hdfs_path])
+        entries = [line for line in out.splitlines()
+                   if line and not line.startswith("Found")]
+        if only_file:
+            entries = [e for e in entries if not e.startswith("d")]
+        paths = [e.split()[-1] for e in entries]
+        return sorted(paths) if sort else paths
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        if overwrite and os.path.exists(local_path):
+            import shutil
+
+            shutil.rmtree(local_path, ignore_errors=True)
+        return self._run(["-get", hdfs_path, local_path])
+
+    def upload(self, hdfs_path, local_path, overwrite=False,
+               retry_times=5):
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        return self._run(["-put", local_path, hdfs_path],
+                         retry=retry_times)
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                  multi_processes=5):
+    """Download this trainer's shard of files (reference:
+    hdfs_utils.py:386 — files round-robined by trainer_id; the process
+    pool is sequentialized here, transfer is IO-bound anyway)."""
+    client.make_local_dirs(local_path)
+    files = client.lsr(hdfs_path)
+    mine = files[trainer_id::max(trainers, 1)]
+    for f in mine:
+        client.download(f, os.path.join(local_path, os.path.basename(f)))
+    return mine
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """(reference: hdfs_utils.py:450)"""
+    uploaded = []
+    for root, _, names in os.walk(local_path):
+        for n in names:
+            lp = os.path.join(root, n)
+            rel = os.path.relpath(lp, local_path)
+            hp = os.path.join(hdfs_path, rel)
+            client.makedirs(os.path.dirname(hp))
+            client.upload(hp, lp, overwrite=overwrite)
+            uploaded.append(hp)
+    return uploaded

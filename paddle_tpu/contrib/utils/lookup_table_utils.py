@@ -1,0 +1,96 @@
+"""Distributed lookup-table persistence helpers (reference:
+python/paddle/fluid/contrib/utils/lookup_table_utils.py —
+convert_dist_to_sparse_program:60, load_persistables_for_increment:122,
+load_persistables_for_inference:208). The reference stitches pserver
+table shards saved by checkpoint_notify back into programs; here the
+shards are the npz files the distributed checkpoint writes
+(distributed/ps.py save_checkpoint)."""
+
+import os
+
+import numpy as np
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+def convert_dist_to_sparse_program(program):
+    """Rewrite distributed lookup_table ops back to local sparse lookups
+    so a trainer-side program can run standalone (reference:
+    lookup_table_utils.py:60 — the inverse of the transpiler's
+    distributed rewrite)."""
+    prog = program.clone()
+    block = prog.desc.global_block()
+    for op in block.ops:
+        if op.type == "lookup_table" and op.attrs.get("is_distributed"):
+            op.attrs["is_distributed"] = False
+            op.attrs["is_sparse"] = True
+        if op.type == "distributed_lookup":
+            raise ValueError(
+                "program was already transpiled for pservers; convert "
+                "the ORIGIN program (before get_trainer_program)")
+    prog._bump_version()
+    return prog
+
+
+def _load_table_shards(dirname, table_name):
+    """Assemble a full table from pserver shard checkpoints."""
+    rows = {}
+    for fname in sorted(os.listdir(dirname)):
+        if not fname.endswith(".npz"):
+            continue
+        with np.load(os.path.join(dirname, fname)) as data:
+            for key in data.files:
+                if key == table_name or key.startswith(
+                        table_name + "@SHARD"):
+                    rows[fname + key] = data[key]
+    if not rows:
+        return None
+    return np.concatenate(list(rows.values()), axis=0)
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var,
+                                    lookup_table_var_path):
+    """Load a dist-trained model for CONTINUED training: dense
+    persistables from dirname, the lookup table from its own shard path
+    (reference: lookup_table_utils.py:122)."""
+    import paddle_tpu.io as ptio
+    from paddle_tpu.executor import global_scope
+
+    ptio.load_persistables(executor, dirname, program)
+    scope = global_scope()
+    table_name = (lookup_table_var if isinstance(lookup_table_var, str)
+                  else lookup_table_var.name)
+    if os.path.isdir(lookup_table_var_path):
+        table = _load_table_shards(lookup_table_var_path, table_name)
+    elif os.path.exists(lookup_table_var_path):
+        with np.load(lookup_table_var_path) as data:
+            table = data[data.files[0]]
+    else:
+        table = None
+    if table is None:
+        raise FileNotFoundError(
+            "no lookup-table shards for %r under %r"
+            % (table_name, lookup_table_var_path))
+    scope.set(table_name, table)
+    return program
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    """Load a dist-trained model for INFERENCE, assembling the sharded
+    table saved by checkpoint_notify (reference:
+    lookup_table_utils.py:208)."""
+    import paddle_tpu.io as ptio
+    from paddle_tpu.executor import global_scope
+
+    try:
+        ptio.load_persistables(executor, dirname, program)
+    except FileNotFoundError:
+        pass
+    table = _load_table_shards(dirname, lookup_table_var_name)
+    if table is not None:
+        global_scope().set(lookup_table_var_name, table)
+    return program

@@ -53,6 +53,29 @@ class LoDTensor:
     def shape(self):
         return list(self._array.shape)
 
+    def has_valid_recursive_sequence_lengths(self):
+        """(reference: lod_tensor.cc CheckAbsLoD) — offsets ascending and
+        the last level ending at dim 0 of the data."""
+        if not self._lod:
+            return True
+        for level in self._lod:
+            if any(b < a for a, b in zip(level, level[1:])):
+                return False
+        if self._array is not None and self._lod:
+            return self._lod[-1][-1] == self._array.shape[0]
+        return True
+
+
+class LoDTensorArray(list):
+    """(reference: pybind LoDTensorArray — a vector<LoDTensor>)."""
+
+    def append(self, t):
+        if not isinstance(t, LoDTensor):
+            arr = t
+            t = LoDTensor()
+            t.set(arr)
+        list.append(self, t)
+
 
 def create_lod_tensor(data, recursive_seq_lens=None, place=None):
     t = LoDTensor()

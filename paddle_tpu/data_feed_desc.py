@@ -24,12 +24,12 @@ class DataFeedDesc:
     """(reference: data_feed_desc.py:30) — accepts a textproto string or
     a path to one."""
 
-    def __init__(self, proto):
+    def __init__(self, proto_file):
         try:
-            with open(proto) as f:
+            with open(proto_file) as f:
                 text = f.read()
         except (OSError, ValueError):
-            text = proto
+            text = proto_file
         self.name = "MultiSlotDataFeed"
         self.batch_size = 32
         self.slots = []

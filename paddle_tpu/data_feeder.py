@@ -97,3 +97,40 @@ class DataFeeder:
                 out[var.name + LENGTH_SUFFIX] = np.asarray(
                     [a.shape[0] for a in arrs], dtype=np.int64)
         return out
+
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Feed dicts for data-parallel places (reference: data_feeder.py
+        feed_parallel). Under SPMD the per-place split is the engine's
+        job; this yields one feed dict per place-chunk of the batch."""
+        import numpy as np
+
+        for item in iterable:
+            fd = self.feed(item)
+            n = num_places or 1
+            first = np.asarray(fd[self.feed_names[0]])
+            # ceil-split: every sample lands somewhere; trailing places
+            # with no rows are skipped rather than fed empty batches
+            per = -(-first.shape[0] // n)
+            for i in range(n):
+                lo = i * per
+                if lo >= first.shape[0]:
+                    break
+                yield {k: np.asarray(v)[lo:lo + per]
+                       for k, v in fd.items()}
+
+    def decorate_reader(self, reader, multi_devices=False,
+                        num_places=None, drop_last=True):
+        """Wrap a batch reader into one yielding feed dicts (reference:
+        data_feeder.py decorate_reader)."""
+
+        def __reader_creator__():
+            if not multi_devices:
+                for item in reader():
+                    yield self.feed(item)
+            else:
+                for item in reader():
+                    for d in self.feed_parallel([item], num_places):
+                        yield d
+
+        return __reader_creator__

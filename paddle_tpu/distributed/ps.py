@@ -574,6 +574,16 @@ class PSClient:
                 pass
             s.close()
 
+    def close(self):
+        """Close the sockets WITHOUT signalling trainer completion — for
+        read-only clients (an evaluator pulling params must not consume a
+        completion slot and stop a live cluster)."""
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
 
 class DistTrainer:
     """Runs a transpiled trainer program: compiled fwd/bwd on the engine,

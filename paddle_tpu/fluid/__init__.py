@@ -20,10 +20,57 @@ from paddle_tpu.framework import (  # noqa: F401
     Variable,
     Operator,
     program_guard,
+    name_scope,
     default_main_program,
     default_startup_program,
     grad_var_name,
 )
+from paddle_tpu.core_shim import (  # noqa: F401
+    LoDTensor,
+    LoDTensorArray,
+)
+from paddle_tpu import backward  # noqa: F401
+from paddle_tpu import recordio_writer  # noqa: F401
+from paddle_tpu import nets  # noqa: F401
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """(reference: lod_tensor.py create_lod_tensor). For list data the
+    per-row lengths must agree with the LAST level of
+    ``recursive_seq_lens`` (the reference asserts the same)."""
+    import numpy as np
+
+    from paddle_tpu.core_shim import LoDTensor as _LT
+
+    if isinstance(data, list):
+        row_lens = [len(np.asarray(r).reshape(-1)) for r in data]
+        if recursive_seq_lens and                 list(recursive_seq_lens[-1]) != row_lens:
+            raise ValueError(
+                "create_lod_tensor: recursive_seq_lens[-1]=%s does not "
+                "match the data row lengths %s"
+                % (recursive_seq_lens[-1], row_lens))
+        arr = np.concatenate(
+            [np.asarray(row).reshape(-1, 1) for row in data], axis=0)
+        t = _LT()
+        t.set(arr, place)
+        t.set_recursive_sequence_lengths(
+            recursive_seq_lens or [row_lens])
+        return t
+    t = _LT()
+    t.set(np.asarray(data), place)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    """(reference: lod_tensor.py create_random_int_lodtensor)."""
+    import numpy as np
+
+    total = sum(recursive_seq_lens[-1])
+    arr = np.random.randint(low, high + 1,
+                            [total] + list(base_shape)).astype("int64")
+    return create_lod_tensor(arr, recursive_seq_lens, place)
 from paddle_tpu.executor import Executor, global_scope, scope_guard  # noqa: F401
 from paddle_tpu.core.scope import Scope  # noqa: F401
 from paddle_tpu.platform import (  # noqa: F401

@@ -453,14 +453,41 @@ class Program:
         self._version += 1
         self.desc._version_token = self._version
 
+    @staticmethod
+    def parse_from_string(binary_str):
+        """Rebuild a Program from serialized desc bytes (reference:
+        framework.py Program.parse_from_string). Accepts both the native
+        serialization and the reference's binary framework.proto wire
+        format (compat importer)."""
+        desc = None
+        try:
+            desc = ProgramDescData.parse_from_string(binary_str)
+        except Exception:
+            from paddle_tpu import compat
+
+            return compat.load_reference_program(binary_str)
+        program = Program()
+        program.desc = desc
+        desc._version_token = 1
+        program.blocks = [Block(program, i)
+                          for i in range(desc.num_blocks())]
+        for b in program.blocks:
+            for name, vd in b.desc.vars.items():
+                v = Variable.__new__(Variable)
+                v.block = b
+                v.desc = vd
+                b.vars[name] = v
+        program._bump_version()
+        return program
+
     def current_block(self):
         return self.blocks[self.current_block_idx]
 
     def global_block(self):
         return self.blocks[0]
 
-    def block(self, idx):
-        return self.blocks[idx]
+    def block(self, index):
+        return self.blocks[index]
 
     def create_block(self, parent_idx=None):
         parent = (
@@ -594,6 +621,21 @@ class program_guard:
 
 def grad_var_name(name):
     return name + "@GRAD"
+
+
+_name_scope_stack = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Debug name scoping for operators (reference: framework.py
+    name_scope — purely cosmetic grouping; ops created inside get the
+    scope prefix recorded for visualization)."""
+    _name_scope_stack.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
 
 
 # -- imperative (dygraph) mode plumbing (reference: framework.py

@@ -16,8 +16,9 @@ class Initializer:
 
 
 class ConstantInitializer(Initializer):
-    def __init__(self, value=0.0):
+    def __init__(self, value=0.0, force_cpu=False):
         self.value = value
+        del force_cpu  # placement is XLA's; constants fold at compile
 
     def __call__(self, var, block):
         block.append_op(
@@ -183,3 +184,14 @@ Bilinear = BilinearInitializer
 
 def force_init_on_cpu():
     return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """(reference: initializer.py init_on_cpu) — forces init ops onto the
+    host. Placement is XLA's under PJRT; kept as a no-op scope for script
+    compatibility."""
+    yield

@@ -168,7 +168,10 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, pserver_endpoints=None):
+    """With ``pserver_endpoints`` the persistable params are refreshed
+    from the RUNNING pservers after the disk load (reference: io.py
+    load_inference_model's endpoints path for distributed increment)."""
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "rb") as f:
         desc = ProgramDescData.parse_from_string(f.read())
@@ -188,6 +191,32 @@ def load_inference_model(dirname, executor, model_filename=None,
     with open(os.path.join(dirname, "__meta__.json")) as f:
         meta = json.load(f)
     load_persistables(executor, dirname, program, filename=params_filename)
+    if pserver_endpoints:
+        import numpy as np
+
+        from paddle_tpu.distributed.ps import PSClient
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+        client = PSClient(list(pserver_endpoints))
+        gb = program.desc.global_block()
+        for name, vd in gb.vars.items():
+            if not vd.persistable or name in ("feed", "fetch"):
+                continue
+            for ep in pserver_endpoints:
+                try:
+                    val = client.get_var(ep, name)
+                except (OSError, AssertionError):
+                    continue
+                # a server answers ('var', None-array) for names it does
+                # not own (e.g. sliced params living under block names) —
+                # keep the disk-loaded value then
+                arr = np.asarray(val)
+                if arr.dtype == object or arr.ndim == 0:
+                    continue
+                scope.set(name, arr)
+                break
+        client.close()
     feed_names = meta["feed_names"]
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, feed_names, fetch_vars

@@ -32,6 +32,7 @@ from paddle_tpu.layers.detection import *  # noqa: F401,F403
 from paddle_tpu.layers.metric_op import accuracy, auc  # noqa: F401
 from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
 from paddle_tpu.layers.learning_rate_scheduler import (  # noqa: F401
+    append_LARS,
     exponential_decay,
     natural_exp_decay,
     inverse_time_decay,

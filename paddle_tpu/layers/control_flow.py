@@ -146,7 +146,15 @@ class StaticRNN:
         return ipt
 
     def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
-               dtype="float32"):
+               init_batch_dim_idx=0, ref_batch_dim_idx=1, dtype="float32"):
+        # the batch-dim indices parameterize which axes carry the batch in
+        # init vs batch_ref (reference: layers/control_flow.py
+        # StaticRNN.memory); the padded batch-major representation fixes
+        # both at 0/1's defaults, so other values are rejected
+        if (init_batch_dim_idx, ref_batch_dim_idx) != (0, 1):
+            raise NotImplementedError(
+                "StaticRNN.memory: only init_batch_dim_idx=0, "
+                "ref_batch_dim_idx=1 (batch-major padded form)")
         from paddle_tpu.layers import tensor as tensor_layers
 
         if init is None:
@@ -172,8 +180,8 @@ class StaticRNN:
         self._memories.append((init, mem))
         return mem
 
-    def update_memory(self, mem, new):
-        self._mem_updates[mem.name] = new.name
+    def update_memory(self, mem, var):
+        self._mem_updates[mem.name] = var.name
 
     def step_output(self, o):
         self._step_outputs.append(o)
@@ -256,6 +264,14 @@ class Switch:
     def __init__(self, name=None):
         self.helper = LayerHelper("switch", name=name)
         self._prev_conds = []
+
+    # ``with layers.Switch() as switch:`` form (reference usage in LR
+    # schedulers and the contrib decoder)
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
 
     @contextlib.contextmanager
     def _guarded_block(self, cond_var):
@@ -470,8 +486,8 @@ class DynamicRNN:
         self._memories.append((init, mem))
         return mem
 
-    def update_memory(self, mem, new):
-        self._mem_updates[mem.name] = new.name
+    def update_memory(self, ex_mem, new_mem):
+        self._mem_updates[ex_mem.name] = new_mem.name
 
     def output(self, *outputs):
         for o in outputs:

@@ -111,7 +111,7 @@ def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
 
 def box_coder(prior_box, prior_box_var, target_box,
               code_type="encode_center_size", box_normalized=True,
-              name=None):
+              name=None, axis=0):
     """(reference: layers/detection.py:345)"""
     helper = LayerHelper("box_coder", name=name)
     out = _out(helper)
@@ -120,7 +120,8 @@ def box_coder(prior_box, prior_box_var, target_box,
         inputs["PriorBoxVar"] = [prior_box_var]
     helper.append_op(
         type="box_coder", inputs=inputs, outputs={"OutputBox": [out]},
-        attrs={"code_type": code_type, "box_normalized": box_normalized})
+        attrs={"code_type": code_type, "box_normalized": box_normalized,
+               "axis": axis})
     return out
 
 
@@ -267,7 +268,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              prior_box_var=None, background_label=0, overlap_threshold=0.5,
              neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
              conf_loss_weight=1.0, match_type="per_prediction",
-             mismatch_value=0, normalize=True, sample_size=None):
+             mismatch_value=0, normalize=True, sample_size=None,
+             mining_type="max_negative"):
     """SSD multibox loss (reference: layers/detection.py:874): match
     priors to ground truths (bipartite + per-prediction), smooth-L1 on
     matched locations, softmax CE with matched/background label targets.
@@ -278,6 +280,11 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     from paddle_tpu.layers import loss as loss_layers
     from paddle_tpu.layers import nn as nn_layers
 
+    if mining_type != "max_negative":
+        # same guard as the reference (layers/detection.py ssd_loss:
+        # "Only mining_type == max_negative is supported")
+        raise ValueError("ssd_loss: only mining_type == 'max_negative' "
+                         "is supported")
     iou = iou_similarity(gt_box, prior_box)            # [N_gt, M]
     match_idx, _ = bipartite_match(iou, match_type,
                                    overlap_threshold)  # [1, M]
@@ -508,6 +515,7 @@ def _np_map(dets, gts, overlap_threshold, ap_version,
 
 def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
                   ap_version="integral"):
     """mAP metric (reference: layers/detection.py:610 → detection_map
     op). Runs host-side through py_func on the static-shape detection
@@ -515,6 +523,13 @@ def detection_map(detect_res, label, class_num, background_label=0,
     from paddle_tpu.layer_helper import LayerHelper
     from paddle_tpu.layers import nn as nn_layers
 
+    if input_states is not None or out_states is not None:
+        raise NotImplementedError(
+            "detection_map: streaming state accumulation "
+            "(input_states/out_states) is not supported — compute mAP "
+            "per evaluation pass or accumulate detections host-side "
+            "(metrics.DetectionMAP does this)")
+    del has_state
     helper = LayerHelper("detection_map")
     out = helper.create_variable_for_type_inference("float32")
     out.desc.shape = [1]

@@ -193,3 +193,31 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     return elementwise_add(
         elementwise_mul(warm, not_done), elementwise_mul(after, done)
     )
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """Layer-wise adaptive rate scaling applied as per-param learning
+    rates (reference: layers/learning_rate_scheduler.py:310 — sets each
+    param's optimize_attr['learning_rate'] to
+    lr * ||w|| / (||g|| + weight_decay * ||w||))."""
+    from paddle_tpu.layers import nn as nn_layers
+    from paddle_tpu.layers import ops as ops_layers
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return grad_norm + param_norm
+        return grad_norm + weight_decay * param_norm
+
+    for param, grad in params_grads:
+        param_lr = param.optimize_attr.get("learning_rate", 1.0)
+        param_norm = ops_layers.sqrt(
+            nn_layers.reduce_sum(input=ops_layers.square(param)))
+        grad_norm = ops_layers.sqrt(
+            nn_layers.reduce_sum(input=ops_layers.square(grad)))
+        if isinstance(param_lr, float) and param_lr == 1.0:
+            decayed_lr = learning_rate * param_norm / _balanced_weight(
+                param_norm, grad_norm)
+        else:
+            decayed_lr = (learning_rate * param_lr * param_norm
+                          / _balanced_weight(param_norm, grad_norm))
+        param.optimize_attr["learning_rate"] = decayed_lr

@@ -138,8 +138,8 @@ def hinge_loss(input, label, name=None):
     return out
 
 
-def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
-            label_length=None):
+def warpctc(input, label, blank=0, norm_by_times=False, use_cudnn=False,
+            input_length=None, label_length=None):
     """CTC loss (reference: layers/nn.py warpctc → warpctc_op.cc).
     ``input``: [B, T, C] unnormalized logits (batch-major padded form of
     the reference's LoD logits); returns [B, 1] per-sequence loss."""
@@ -156,8 +156,8 @@ def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
     return loss
 
 
-def edit_distance(input, label, normalized=True, input_length=None,
-                  label_length=None, name=None):
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
     """Levenshtein distance (reference: layers/nn.py edit_distance).
     Returns (distance [B, 1], sequence_num [1])."""
     helper = LayerHelper("edit_distance", name=name)
@@ -171,5 +171,6 @@ def edit_distance(input, label, normalized=True, input_length=None,
     helper.append_op(
         type="edit_distance", inputs=inputs,
         outputs={"Out": [out], "SequenceNum": [seq_num]},
-        attrs={"normalized": normalized})
+        attrs={"normalized": normalized,
+               "ignored_tokens": list(ignored_tokens or [])})
     return out, seq_num

@@ -381,7 +381,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",
                in_place=False, name=None, moving_mean_name=None,
                moving_variance_name=None, do_model_average_for_mean_and_var=False,
-               use_global_stats=False):
+               fuse_with_relu=False, use_global_stats=False):
     """Batch normalization (reference: layers/nn.py:2753) with persistable
     moving mean/variance updated in-program."""
     helper = LayerHelper("batch_norm", name=name, act=act)
@@ -937,6 +937,10 @@ def maxout(x, groups, name=None):
 def image_resize(input, out_shape=None, scale=None, name=None,
                  resample="BILINEAR", actual_shape=None, align_corners=True,
                  align_mode=1):
+    if actual_shape is not None:
+        raise NotImplementedError(
+            "image_resize: actual_shape (runtime output shape) is "
+            "incompatible with XLA static shapes; pass out_shape/scale")
     if out_shape is None:
         out_shape = [int(input.shape[2] * scale), int(input.shape[3] * scale)]
     op_type = "bilinear_interp" if resample == "BILINEAR" else "nearest_interp"
@@ -946,17 +950,26 @@ def image_resize(input, out_shape=None, scale=None, name=None,
         type=op_type,
         inputs={"X": [input]},
         outputs={"Out": [out]},
-        attrs={"out_h": int(out_shape[0]), "out_w": int(out_shape[1])},
+        attrs={"out_h": int(out_shape[0]), "out_w": int(out_shape[1]),
+               "align_corners": bool(align_corners),
+               "align_mode": int(align_mode)},
     )
     return out
 
 
-def resize_bilinear(input, out_shape=None, scale=None, name=None):
-    return image_resize(input, out_shape, scale, name, "BILINEAR")
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape=actual_shape,
+                        align_corners=align_corners,
+                        align_mode=align_mode)
 
 
-def resize_nearest(input, out_shape=None, scale=None, name=None):
-    return image_resize(input, out_shape, scale, name, "NEAREST")
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape=actual_shape,
+                        align_corners=align_corners)
 
 
 def clip(x, min, max, name=None):
@@ -1040,7 +1053,10 @@ def cumsum(x, axis=None, exclusive=None, reverse=None):
 
 
 # -- sequence layers (padded+length representation, see ops/sequence_ops) --
-def sequence_pool(input, pool_type, length=None):
+def sequence_pool(input, pool_type, is_test=False, length=None):
+    # ``is_test`` only gates the reference kernel's MaxIndex scratch
+    # output (sequence_pool_op.cc); the functional lowering derives the
+    # backward from the forward, so it needs no flag
     helper = LayerHelper("sequence_pool")
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     inputs = {"X": [input]}
@@ -1055,7 +1071,8 @@ def sequence_pool(input, pool_type, length=None):
     return out
 
 
-def sequence_softmax(input, length=None, name=None):
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    del use_cudnn  # CUDA knob; XLA picks the softmax lowering
     helper = LayerHelper("sequence_softmax", name=name)
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     inputs = {"X": [input]}
@@ -1233,7 +1250,8 @@ def dot_product_attention(querys, keys, values):
 
 
 def _cmp_layer(op_type):
-    def layer(x, y, cond=None):
+    def layer(x, y, force_cpu=None, cond=None):
+        del force_cpu  # placement knob; XLA decides
         helper = LayerHelper(op_type)
         if cond is None:
             cond = helper.create_variable_for_type_inference(dtype="bool")
@@ -1298,10 +1316,10 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, seq_len=None,
     return hidden, cell
 
 
-def dynamic_gru(input, size, h_0=None, seq_len=None, param_attr=None,
-                bias_attr=None, is_reverse=False,
-                gate_activation="sigmoid", candidate_activation="tanh",
-                dtype="float32", name=None):
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                seq_len=None, dtype="float32", name=None):
     """GRU over a padded [B, T, 3H] pre-projected input (reference:
     layers/nn.py dynamic_gru). Returns hidden [B, T, H]."""
     helper = LayerHelper("dynamic_gru", name=name, param_attr=param_attr,
@@ -1325,38 +1343,62 @@ def dynamic_gru(input, size, h_0=None, seq_len=None, param_attr=None,
             "is_reverse": is_reverse,
             "gate_activation": gate_activation,
             "activation": candidate_activation,
+            "origin_mode": origin_mode,
         },
     )
     return hidden
 
 
-def beam_search(pre_ids, pre_scores, scores, beam_size, end_id,
-                first_step=False, name=None):
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False, first_step=False):
     """One beam-search step (reference: layers/nn.py:3873 — fixed
-    batch*beam rows instead of LoD shrinking). Returns (selected_ids,
-    selected_scores, parent_idx)."""
+    batch*beam rows instead of LoD shrinking). ``ids`` optionally maps
+    score columns to token ids (None means column index IS the id, the
+    common vocab-scores case); ``level`` (the reference's LoD level) is
+    meaningless in the padded form; with ``is_accumulated=False`` the
+    scores are per-step probabilities and are log-accumulated onto
+    pre_scores here, as the reference op does. Returns (selected_ids,
+    selected_scores), or a 3-tuple including parent_idx when
+    ``return_parent_idx=True``."""
+    del level
     helper = LayerHelper("beam_search", name=name)
     sel_ids = helper.create_variable_for_type_inference("int64")
     sel_scores = helper.create_variable_for_type_inference(scores.dtype)
     parent = helper.create_variable_for_type_inference("int64")
+    inputs = {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+              "scores": [scores]}
+    if ids is not None:
+        inputs["ids"] = [ids]
     helper.append_op(
         type="beam_search",
-        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
-                "scores": [scores]},
+        inputs=inputs,
         outputs={"selected_ids": [sel_ids],
                  "selected_scores": [sel_scores],
                  "parent_idx": [parent]},
         attrs={"beam_size": beam_size, "end_id": end_id,
+               "is_accumulated": bool(is_accumulated),
                "first_step": first_step},
     )
-    return sel_ids, sel_scores, parent
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
 
 
-def beam_search_decode(ids_array, scores_array, parent_array, beam_size,
-                       end_id, name=None):
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parent_array=None):
     """Backtrack a finished beam decode from the step arrays (reference:
     layers beam_search_decode). Returns (sentence_ids [BW, max_len],
-    sentence_scores [BW, 1])."""
+    sentence_scores [BW, 1]). The padded representation needs the
+    parent-pointer array our beam_search emits (the reference recovers
+    parents from LoD; here they are explicit)."""
+    ids_array, scores_array = ids, scores
+    if parent_array is None:
+        raise ValueError(
+            "beam_search_decode needs parent_array= (the parent_idx "
+            "array collected from beam_search steps); the padded beam "
+            "representation stores parent pointers explicitly where the "
+            "reference recovers them from LoD")
     helper = LayerHelper("beam_search_decode", name=name)
     sent_ids = helper.create_variable_for_type_inference("int64")
     sent_scores = helper.create_variable_for_type_inference("float32")
@@ -1841,7 +1883,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, name=None):
+           ceil_mode=False, name=None, exclusive=True):
     """(reference: layers/nn.py pool3d)"""
     helper = LayerHelper("pool3d", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
@@ -1850,7 +1892,8 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
         type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
         attrs={"ksize": to3(pool_size), "strides": to3(pool_stride),
                "paddings": to3(pool_padding), "pooling_type": pool_type,
-               "global_pooling": global_pooling})
+               "global_pooling": global_pooling,
+               "exclusive": exclusive})
     return out
 
 
@@ -2002,7 +2045,7 @@ def lod_reset(x, y=None, target_lod=None):
 def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
               data_layout="NCHW", in_place=False, name=None,
               moving_mean_name=None, moving_variance_name=None,
-              do_model_average_for_mean_and_var=False):
+              do_model_average_for_mean_and_var=False, use_mkldnn=False):
     """(reference: layers/nn.py data_norm) — normalization by accumulated
     batch statistics held as persistable state."""
     helper = LayerHelper("data_norm", name=name, act=act)

@@ -31,26 +31,48 @@ def beam_search(ctx, ins, attrs):
     W = int(attrs["beam_size"])
     end_id = int(attrs["end_id"])
     first = bool(attrs.get("first_step", False))
+    # reference beam_search_op is_accumulated: True -> scores already
+    # carry the accumulated path score; False -> per-step probabilities,
+    # log'ed and added onto pre_scores here
+    accumulated = bool(attrs.get("is_accumulated", True))
 
     BW, V = scores.shape
     B = BW // W
 
     finished = pre_ids == end_id
-    # finished rows: only candidate is end_id at frozen score
-    cand = jnp.where(finished[:, None], _NEG, pre_scores[:, None] + scores)
-    end_col = jnp.full((BW, V), _NEG, scores.dtype).at[:, end_id].set(
-        jnp.where(finished, pre_scores, _NEG))
-    cand = jnp.maximum(cand, end_col)
+    if accumulated:
+        acc = scores
+    else:
+        acc = pre_scores[:, None] + jnp.log(jnp.maximum(scores, 1e-30))
+    cand = jnp.where(finished[:, None], _NEG, acc)
+    # a VIRTUAL end-candidate column carries each finished row's frozen
+    # score — valid whether the score columns are vocabulary ids or
+    # candidate slots from an ids tensor (and immune to end_id >= V)
+    end_col = jnp.where(finished, pre_scores, _NEG)[:, None]
+    cand = jnp.concatenate([cand, end_col], axis=1)      # [BW, V+1]
     if first:
         # only the first beam of each group is live at step 0
         beam_idx = jnp.arange(BW) % W
         cand = jnp.where((beam_idx == 0)[:, None], cand, _NEG)
 
-    grouped = cand.reshape(B, W * V)
+    Vx = V + 1
+    grouped = cand.reshape(B, W * Vx)
     top_scores, top_flat = lax.top_k(grouped, W)        # [B, W]
-    parent_local = top_flat // V                         # beam within group
-    token = top_flat % V
+    parent_local = top_flat // Vx                        # beam within group
+    col = top_flat % Vx
     parent_global = (jnp.arange(B)[:, None] * W + parent_local).reshape(-1)
+    cand_ids = ins.get("ids", [None])
+    if cand_ids and cand_ids[0] is not None:
+        # score columns are candidate slots; map through the ids tensor
+        # (reference: the Ids input of beam_search_op); the virtual
+        # column maps to end_id
+        ids_mat = cand_ids[0].reshape(BW, V).astype(jnp.int64)
+    else:
+        ids_mat = jnp.broadcast_to(jnp.arange(V, dtype=jnp.int64),
+                                   (BW, V))
+    ids_ext = jnp.concatenate(
+        [ids_mat, jnp.full((BW, 1), end_id, jnp.int64)], axis=1)
+    token = ids_ext[parent_global, col.reshape(-1)].reshape(B, W)
     return {
         "selected_ids": [token.reshape(-1, 1).astype(jnp.int64)],
         "selected_scores": [top_scores.reshape(-1, 1)],

@@ -157,10 +157,16 @@ def conditional_block(ctx, ins, attrs):
         init[n] = env[n]
     _run_sub_block(ctx, sub, env)
     flag = jnp.reshape(cond, ()).astype(jnp.bool_)
-    outs = [
-        jnp.where(flag, env[n].astype(init[n].dtype), init[n])
-        for n in out_names
-    ]
+
+    def _merge(new, old):
+        # plain tensors, or tensor-array pytrees ({"buf","len"}) written
+        # under the condition — select leaf-wise
+        import jax as _jax
+
+        return _jax.tree_util.tree_map(
+            lambda a, b: jnp.where(flag, a.astype(b.dtype), b), new, old)
+
+    outs = [_merge(env[n], init[n]) for n in out_names]
     return {"Out": outs, "Scope": []}
 
 

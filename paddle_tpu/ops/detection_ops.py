@@ -210,13 +210,17 @@ def box_coder(ctx, ins, attrs):
             out = out / pvar[None, :, :]
         return {"OutputBox": [out]}
 
-    # decode: tb [N, M, 4] offsets -> boxes [N, M, 4]
+    # decode: tb [N, M, 4] offsets -> boxes [N, M, 4]. ``axis`` picks the
+    # TargetBox dim the priors broadcast along (reference: box_coder_op.h
+    # decode axis attr: 0 -> priors pair with dim 1, 1 -> with dim 0)
+    axis = int(attrs.get("axis", 0))
+    ax = (lambda v: v[None, :]) if axis == 0 else (lambda v: v[:, None])
     if pvar is not None:
-        tb = tb * pvar[None, :, :]
-    dcx = tb[..., 0] * pw[None, :] + pcx[None, :]
-    dcy = tb[..., 1] * ph[None, :] + pcy[None, :]
-    dw = jnp.exp(tb[..., 2]) * pw[None, :]
-    dh = jnp.exp(tb[..., 3]) * ph[None, :]
+        tb = tb * (pvar[None, :, :] if axis == 0 else pvar[:, None, :])
+    dcx = tb[..., 0] * ax(pw) + ax(pcx)
+    dcy = tb[..., 1] * ax(ph) + ax(pcy)
+    dw = jnp.exp(tb[..., 2]) * ax(pw)
+    dh = jnp.exp(tb[..., 3]) * ax(ph)
     out = jnp.stack([
         dcx - dw / 2.0, dcy - dh / 2.0,
         dcx + dw / 2.0 - one, dcy + dh / 2.0 - one,

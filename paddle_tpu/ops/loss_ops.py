@@ -259,6 +259,23 @@ def edit_distance(ctx, ins, attrs):
              if r_len and r_len[0] is not None
              else jnp.full((B,), L2, jnp.int32))
     normalized = attrs.get("normalized", True)
+    ignored = list(attrs.get("ignored_tokens") or [])
+    if ignored:
+        # remove ignored tokens by stable compaction (reference:
+        # edit_distance op's ignored_tokens erasing tokens before the DP)
+        def compact(seq, lens):
+            L = seq.shape[1]
+            ign = jnp.zeros(seq.shape, bool)
+            for t in ignored:
+                ign |= (seq == t)
+            ign |= jnp.arange(L)[None, :] >= lens[:, None]
+            key = ign.astype(jnp.int32) * (2 * L) + jnp.arange(L)[None, :]
+            order = jnp.argsort(key, axis=1)
+            return (jnp.take_along_axis(seq, order, axis=1),
+                    jnp.sum(~ign, axis=1).astype(jnp.int32))
+
+        hyp, h_len = compact(hyp, h_len)
+        ref, r_len = compact(ref, r_len)
 
     cols = jnp.arange(L2 + 1, dtype=jnp.float32)
     row0 = jnp.broadcast_to(cols, (B, L2 + 1))          # D[0, j] = j

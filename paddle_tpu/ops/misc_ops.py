@@ -472,8 +472,13 @@ def pool3d(ctx, ins, attrs):
         out = lax.reduce_window(x, -jnp.inf, lax.max, dims, strd, padding)
     else:
         s = lax.reduce_window(x, 0.0, lax.add, dims, strd, padding)
-        n = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strd,
-                              padding)
+        if attrs.get("exclusive", True):
+            # divisor counts only in-bounds elements (reference pool_op
+            # exclusive=True); ones are zero-padded by the window
+            n = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims,
+                                  strd, padding)
+        else:
+            n = float(ksize[0] * ksize[1] * ksize[2])
         out = s / n
     return {"Out": [out]}
 

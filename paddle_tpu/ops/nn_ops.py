@@ -490,26 +490,62 @@ def group_norm(ctx, ins, attrs):
     return {"Y": [y], "Mean": [jnp.squeeze(mean)], "Variance": [jnp.squeeze(var)]}
 
 
+def _interp_src(out_n, in_n, align_corners, align_mode):
+    """Source coordinates per output index (reference:
+    operators/interpolate_op.h — align_corners uses the (in-1)/(out-1)
+    ratio; align_mode 1 is src = ratio*dst, mode 0 the half-pixel
+    src = ratio*(dst+0.5)-0.5)."""
+    i = jnp.arange(out_n, dtype=jnp.float32)
+    if align_corners:
+        ratio = (in_n - 1) / float(max(out_n - 1, 1))
+        return i * ratio
+    ratio = in_n / float(out_n)
+    if align_mode == 1:
+        return jnp.clip(i * ratio, 0.0, in_n - 1.0)
+    return jnp.clip((i + 0.5) * ratio - 0.5, 0.0, in_n - 1.0)
+
+
 @register_op("bilinear_interp")
 def bilinear_interp(ctx, ins, attrs):
     x = single(ins, "X")  # NCHW
-    out_h = attrs.get("out_h")
-    out_w = attrs.get("out_w")
-    out = jax.image.resize(
-        x, (x.shape[0], x.shape[1], out_h, out_w), method="bilinear"
-    )
-    return {"Out": [out]}
+    out_h, out_w = attrs.get("out_h"), attrs.get("out_w")
+    ac = bool(attrs.get("align_corners", True))
+    am = int(attrs.get("align_mode", 1))
+    H, W = x.shape[2], x.shape[3]
+    sy = _interp_src(out_h, H, ac, am)
+    sx = _interp_src(out_w, W, ac, am)
+    y0 = jnp.floor(sy).astype(jnp.int32)
+    x0 = jnp.floor(sx).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = (sy - y0)[None, None, :, None]
+    wx = (sx - x0)[None, None, None, :]
+    v00 = x[:, :, y0][:, :, :, x0]
+    v01 = x[:, :, y0][:, :, :, x1]
+    v10 = x[:, :, y1][:, :, :, x0]
+    v11 = x[:, :, y1][:, :, :, x1]
+    out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+           + v10 * wy * (1 - wx) + v11 * wy * wx)
+    return {"Out": [out.astype(x.dtype)]}
 
 
 @register_op("nearest_interp")
 def nearest_interp(ctx, ins, attrs):
     x = single(ins, "X")
-    out_h = attrs.get("out_h")
-    out_w = attrs.get("out_w")
-    out = jax.image.resize(
-        x, (x.shape[0], x.shape[1], out_h, out_w), method="nearest"
-    )
-    return {"Out": [out]}
+    out_h, out_w = attrs.get("out_h"), attrs.get("out_w")
+    ac = bool(attrs.get("align_corners", True))
+    H, W = x.shape[2], x.shape[3]
+    if ac:
+        iy = jnp.round(jnp.arange(out_h) * (H - 1)
+                       / max(out_h - 1, 1)).astype(jnp.int32)
+        ix = jnp.round(jnp.arange(out_w) * (W - 1)
+                       / max(out_w - 1, 1)).astype(jnp.int32)
+    else:
+        iy = jnp.floor(jnp.arange(out_h) * (H / out_h)).astype(jnp.int32)
+        ix = jnp.floor(jnp.arange(out_w) * (W / out_w)).astype(jnp.int32)
+    iy = jnp.clip(iy, 0, H - 1)
+    ix = jnp.clip(ix, 0, W - 1)
+    return {"Out": [x[:, :, iy][:, :, :, ix]]}
 
 
 @register_op("prelu")

@@ -271,3 +271,20 @@ def ftrl(ctx, ins, attrs):
         "SquaredAccumOut": [new_sq],
         "LinearAccumOut": [lin_out],
     }
+
+
+@register_no_grad_op("model_average_accum",
+                     inplace_map={"SumOut": "Sum", "CntOut": "Cnt"})
+def model_average_accum(ctx, ins, attrs):
+    """Running parameter sum for ModelAverage (reference:
+    optimizer.py:1484 ModelAverage's sum_1/2/3 + num_accumulates ops,
+    simplified to a single restarting window: history drops every
+    max_average_window steps instead of the reference's 3-tier fold)."""
+    param = single(ins, "Param")
+    s = single(ins, "Sum")
+    c = single(ins, "Cnt")
+    maxw = float(attrs.get("max_average_window", 10000))
+    restart = c >= maxw
+    s2 = jnp.where(restart, param, s + param)
+    c2 = jnp.where(restart, 1.0, c + 1.0)
+    return {"SumOut": [s2], "CntOut": [c2]}

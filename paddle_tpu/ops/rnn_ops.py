@@ -106,6 +106,9 @@ def dynamic_gru(ctx, ins, attrs):
     gate_act = _act(attrs.get("gate_activation", "sigmoid"))
     cand_act = _act(attrs.get("activation", "tanh"))
     reverse = bool(attrs.get("is_reverse", False))
+    # origin_mode flips the interpolation to the original GRU paper's
+    # h = (1-u)*h_prev + u*c (reference: gru_op.h origin_mode branch)
+    origin = bool(attrs.get("origin_mode", False))
 
     w_g = w[:, :2 * H]   # update+reset recurrent weights
     w_c = w[:, 2 * H:]   # candidate recurrent weights
@@ -124,7 +127,10 @@ def dynamic_gru(ctx, ins, attrs):
         u = gate_act(gates[:, :H])
         r = gate_act(gates[:, H:])
         c = cand_act(xc + (r * h_prev) @ w_c)
-        h = u * h_prev + (1.0 - u) * c
+        if origin:
+            h = (1.0 - u) * h_prev + u * c
+        else:
+            h = u * h_prev + (1.0 - u) * c
         if seq_len is not None:
             tt = (T - 1 - t) if reverse else t
             valid = (tt < seq_len)[:, None]

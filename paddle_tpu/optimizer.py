@@ -4,6 +4,8 @@ Adagrad:628, Adam:717, Adamax:877, DecayedAdagrad:1010, Adadelta:1095,
 RMSProp:1192, Ftrl:1342, ModelAverage:1484). Each appends update ops to the
 program; the XLA engine fuses them into the train step executable."""
 
+import contextlib
+
 import numpy as np
 
 from paddle_tpu import unique_name
@@ -215,13 +217,16 @@ class LarsMomentum(Optimizer):
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
-                 name=None):
+                 name=None, initial_accumulator_value=0.0):
         super().__init__(learning_rate, regularization, name)
         self._epsilon = epsilon
+        self._initial_accumulator_value = initial_accumulator_value
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
-            self._add_accumulator("moment", p)
+            self._add_accumulator(
+                "moment", p,
+                fill_value=self._initial_accumulator_value)
 
     def _append_optimize_op(self, block, param_and_grad):
         param, grad = param_and_grad
@@ -503,17 +508,80 @@ class Ftrl(Optimizer):
 
 
 class ModelAverage(Optimizer):
-    """Capability placeholder matching reference optimizer.py:1484 —
-    averaging windows over parameter history. Round-1: identity apply."""
+    """Parameter averaging for evaluation (reference: optimizer.py:1484).
+    Appends per-param accumulation ops to the CURRENT main program at
+    construction (as the reference does); ``apply`` swaps params for
+    their window averages in the scope, ``restore`` swaps back. The
+    reference's three-tier sum folding is simplified to one restarting
+    window of max_average_window steps."""
 
     def __init__(self, average_window_rate, min_average_window=10000,
                  max_average_window=10000, regularization=None, name=None):
+        from paddle_tpu.framework import (OpRole, default_main_program,
+                                          default_startup_program)
+
         super().__init__(0.0, regularization, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._avg_params = []
+        program = default_main_program()
+        block = program.global_block()
+        with program._op_role_guard(OpRole.Optimize):
+            for p in program.all_parameters():
+                if not p.trainable:
+                    continue
+                s = self._add_accumulator("ma_sum", p)
+                c = self._add_accumulator("ma_cnt", p, shape=[1])
+                block.append_op(
+                    type="model_average_accum",
+                    inputs={"Param": [p], "Sum": [s], "Cnt": [c]},
+                    outputs={"SumOut": [s], "CntOut": [c]},
+                    attrs={"max_average_window": self.max_average_window,
+                           "op_role_var": [p.name]},
+                )
+                self._avg_params.append((p, s, c))
+        self._stash = {}
 
     def minimize(self, loss, **kwargs):
         raise NotImplementedError(
-            "ModelAverage applies to already-trained programs"
-        )
+            "ModelAverage accumulates alongside another optimizer; use "
+            "apply()/restore() around evaluation")
+
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        """Swap params for their averages (reference ModelAverage.apply,
+        a context manager around evaluation)."""
+        import numpy as np
+
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+        self._stash = {}
+        for p, s, c in self._avg_params:
+            cur = scope.get(p.name)
+            sv = scope.get(s.name)
+            cv = scope.get(c.name)
+            if cur is None or sv is None or cv is None:
+                continue
+            cnt = float(np.asarray(cv).reshape(-1)[0])
+            if cnt < max(self.min_average_window, 1):
+                continue
+            self._stash[p.name] = np.asarray(cur).copy()
+            scope.set(p.name, np.asarray(sv) / cnt)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+        for name, val in self._stash.items():
+            scope.set(name, val)
+        self._stash = {}
 
 
 # Reference-style aliases (fluid.optimizer.SGDOptimizer etc.)

@@ -1,6 +1,10 @@
 """Reader composition (reference: python/paddle/reader/decorator.py)."""
 
+from paddle_tpu.reader import creator  # noqa: F401
 from paddle_tpu.reader.decorator import (  # noqa: F401
+    Fake,
+    PipeReader,
+    multiprocess_reader,
     batch,
     buffered,
     cache,

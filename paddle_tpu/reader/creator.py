@@ -1,0 +1,42 @@
+"""Reader creators (reference: python/paddle/reader/creator.py —
+np_array:22, text_file:42, recordio:60)."""
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Yield rows (highest-dim slices) of a numpy array."""
+
+    def reader():
+        for e in x:
+            yield e
+
+    return reader
+
+
+def text_file(path):
+    """Yield stripped lines of a text file."""
+
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Yield raw records from RecordIO files (comma-separated paths or a
+    list)."""
+    from paddle_tpu import recordio as rio
+    from paddle_tpu.reader.decorator import buffered
+
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        for p in paths:
+            for rec in rio.Reader(p):
+                yield rec
+
+    return buffered(reader, buf_size)

@@ -172,3 +172,127 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             yield pickle.loads(item)
 
     return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Merge readers, each running in its own process (reference:
+    python/paddle/reader/decorator.py:338 — pipe mode by default, queue
+    mode as the /dev/shm-free fallback)."""
+    import multiprocessing
+    import pickle
+
+    def read_into(reader, sink):
+        for sample in reader():
+            if sample is None:
+                raise ValueError("sample has None")
+            sink(pickle.dumps(sample))
+        sink(pickle.dumps(None))
+
+    def queue_reader():
+        queue = multiprocessing.Queue(queue_size)
+        procs = [multiprocessing.Process(
+            target=read_into, args=(r, queue.put)) for r in readers]
+        for p in procs:
+            p.start()
+        finish_num = 0
+        while finish_num < len(readers):
+            sample = pickle.loads(queue.get())
+            if sample is None:
+                finish_num += 1
+            else:
+                yield sample
+        for p in procs:
+            p.join()
+
+    def pipe_reader():
+        conns = []
+        procs = []
+        for r in readers:
+            parent_conn, child_conn = multiprocessing.Pipe(duplex=False)
+            proc = multiprocessing.Process(
+                target=read_into, args=(r, child_conn.send_bytes))
+            proc.start()
+            child_conn.close()
+            conns.append(parent_conn)
+            procs.append(proc)
+        live = list(conns)
+        while live:
+            for conn in multiprocessing.connection.wait(live):
+                try:
+                    data = conn.recv_bytes()
+                except EOFError:
+                    live.remove(conn)
+                    continue
+                sample = pickle.loads(data)
+                if sample is None:
+                    live.remove(conn)
+                    conn.close()
+                else:
+                    yield sample
+        for p in procs:
+            p.join()
+
+    return pipe_reader if use_pipe else queue_reader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (reference:
+    python/paddle/reader/decorator.py:438)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import subprocess
+
+        process = subprocess.Popen(
+            self.command.split(" "), bufsize=self.bufsize,
+            stdout=subprocess.PIPE)
+        if self.file_type == "gzip":
+            import zlib
+
+            decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        remained = ""
+        while True:
+            buff = process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == "gzip":
+                decomp_buff = decomp.decompress(buff).decode("utf-8",
+                                                             "ignore")
+            else:
+                decomp_buff = buff.decode("utf-8", "ignore")
+            if cut_lines:
+                lines = (remained + decomp_buff).split(line_break)
+                remained = lines.pop(-1)
+                for line in lines:
+                    yield line
+            else:
+                yield decomp_buff
+        if cut_lines and remained:
+            yield remained
+
+
+class Fake:
+    """Cache the first sample and replay it (reference:
+    python/paddle/reader/decorator.py:509 — for IO-free speed tests)."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_data = None
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_data != data_num:
+                self.yield_data += 1
+                yield self.data
+            self.yield_data = 0
+
+        self.yield_data = 0
+        return fake_reader

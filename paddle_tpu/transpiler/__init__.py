@@ -3,6 +3,8 @@
 from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
     DistributeTranspiler,
     DistributeTranspilerConfig,
+    HashName,
+    RoundRobin,
 )
 from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
     InferenceTranspiler,

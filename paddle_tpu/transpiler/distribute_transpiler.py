@@ -39,7 +39,7 @@ class DistributeTranspilerConfig:
 
 
 class RoundRobin:
-    """(reference: ps_dispatcher.py)"""
+    """(reference: ps_dispatcher.py RoundRobin)"""
 
     def __init__(self, pserver_endpoints):
         self._eps = list(pserver_endpoints)
@@ -51,6 +51,32 @@ class RoundRobin:
             out.append(self._eps[self._i % len(self._eps)])
             self._i += 1
         return out
+
+    def reset(self):
+        self._i = 0
+
+
+class HashName:
+    """(reference: ps_dispatcher.py HashName) — stable hash of the var
+    name picks the endpoint, so re-transpiles agree without shared
+    state."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    @staticmethod
+    def _hash_block(entry, total):
+        import zlib
+
+        name = entry[1] or entry[0] if isinstance(entry, tuple) else entry
+        return zlib.crc32(str(name).encode("utf-8")) % total
+
+    def dispatch(self, varlist):
+        return [self._eps[self._hash_block(v, len(self._eps))]
+                for v in varlist]
+
+    def reset(self):
+        pass
 
 
 class DistributeTranspiler:

@@ -21,6 +21,15 @@ def generate(key):
     return generator(key)
 
 
+def switch(new_generator=None):
+    """Swap the global generator, returning the old one (reference:
+    unique_name.py switch)."""
+    global generator
+    old = generator
+    generator = new_generator or UniqueNameGenerator()
+    return old
+
+
 @contextlib.contextmanager
 def guard(new_generator=None):
     global generator

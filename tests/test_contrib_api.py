@@ -1,0 +1,222 @@
+"""Contrib API functional tests: the decoder framework
+(InitState/StateCell/TrainingDecoder/BeamSearchDecoder — reference:
+contrib/decoder/beam_search_decoder.py + tests/test_beam_search_decoder.py),
+pruners, QuantizeTranspiler, ModelAverage-adjacent utilities, and the
+op/memory statistics."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def test_training_decoder_trains():
+    """A simple-RNN TrainingDecoder learns next-token prediction (the
+    reference's test_beam_search_decoder.py training half, on the padded
+    batch form)."""
+    from paddle_tpu.contrib import InitState, StateCell, TrainingDecoder
+
+    V, D, H, T, B = 12, 8, 16, 5, 8
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[T], dtype="int64")
+        trg = fluid.layers.data(name="trg", shape=[T], dtype="int64")
+        src_emb = fluid.layers.embedding(src, size=[V, D], dtype="float32")
+        enc = fluid.layers.reduce_mean(src_emb, dim=1)     # [B, D]
+        enc_h = fluid.layers.fc(input=enc, size=H, act="tanh")
+
+        init_state = InitState(init=enc_h)
+        state_cell = StateCell(inputs={"x": None},
+                               states={"h": init_state}, out_state="h")
+
+        @state_cell.state_updater
+        def updater(cell):
+            x = cell.get_input("x")
+            h = cell.get_state("h")
+            new_h = fluid.layers.fc(input=[x, h], size=H, act="tanh")
+            cell.set_state("h", new_h)
+
+        trg_emb = fluid.layers.embedding(trg, size=[V, D],
+                                         dtype="float32")
+        lens = fluid.layers.data(name="lens", shape=[1], dtype="int64")
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            cur = decoder.step_input(trg_emb, length=lens)
+            decoder.state_cell.compute_state(inputs={"x": cur})
+            score = fluid.layers.fc(
+                input=decoder.state_cell.get_state("h"), size=V,
+                act="softmax")
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        probs = decoder()
+        label = fluid.layers.data(name="label", shape=[T], dtype="int64")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(
+            input=fluid.layers.reshape(probs, shape=[-1, V]),
+            label=fluid.layers.reshape(label, shape=[-1, 1])))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    src_v = rng.randint(0, V, (B, T)).astype(np.int64)
+    trg_v = rng.randint(0, V, (B, T)).astype(np.int64)
+    # learnable target: next token = (current + 1) mod V
+    lbl_v = (trg_v + 1) % V
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            (l,) = exe.run(
+                main,
+                feed={"src": src_v, "trg": trg_v, "label": lbl_v,
+                      "lens": np.full((B, 1), T, np.int64)},
+                fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_beam_search_decoder_decodes():
+    """BeamSearchDecoder produces finite-scored token rows through the
+    full read_array/state-gather/beam_search/backtrack machinery."""
+    from paddle_tpu.contrib import InitState, StateCell, BeamSearchDecoder
+
+    V, D, H, BW = 10, 6, 8, 6   # batch 2 x beam 3
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        init_ids = fluid.layers.data(name="init_ids", shape=[1],
+                                     dtype="int64")
+        init_scores = fluid.layers.data(name="init_scores", shape=[1],
+                                        dtype="float32")
+        boot_h = fluid.layers.data(name="boot_h", shape=[H],
+                                   dtype="float32")
+        state_cell = StateCell(inputs={"x": None},
+                               states={"h": InitState(init=boot_h)},
+                               out_state="h")
+
+        @state_cell.state_updater
+        def updater(cell):
+            x = cell.get_input("x")
+            h = cell.get_state("h")
+            cell.set_state(
+                "h", fluid.layers.fc(input=[x, h], size=H, act="tanh"))
+
+        decoder = BeamSearchDecoder(
+            state_cell=state_cell, init_ids=init_ids,
+            init_scores=init_scores, target_dict_dim=V, word_dim=D,
+            topk_size=V, sparse_emb=False, max_len=4, beam_size=3,
+            end_id=0)
+        decoder.decode()
+        ids, scores = decoder()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out_ids, out_scores = exe.run(
+            main,
+            feed={
+                "init_ids": np.ones((BW, 1), np.int64),
+                "init_scores": np.zeros((BW, 1), np.float32),
+                "boot_h": np.random.RandomState(0).randn(
+                    BW, H).astype(np.float32),
+            },
+            fetch_list=[ids, scores])
+    out_ids = np.asarray(out_ids)
+    assert out_ids.shape[0] == BW
+    assert ((out_ids >= 0) & (out_ids < V)).all()
+    assert np.isfinite(np.asarray(out_scores)).all()
+
+
+def test_pruners_and_compress_pass():
+    from paddle_tpu.contrib import (CompressPass, ImitationGraph,
+                                    MagnitudePruner, RatioPruner,
+                                    SensitivePruneStrategy)
+
+    w = np.array([[0.5, -0.01], [0.002, -2.0]], np.float32)
+    mp = MagnitudePruner(threshold=0.1)
+    out = mp.prune(w)
+    assert out[0, 1] == 0 and out[1, 0] == 0 and out[1, 1] == -2.0
+
+    rp = RatioPruner(ratios={"*": 0.5})
+    out = rp.prune(w)
+    assert (out == 0).sum() == 2
+    assert out[1, 1] == -2.0  # largest magnitudes survive
+
+    # compress pass drives the strategy over a trained program's scope
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=4,
+                        param_attr=fluid.ParamAttr(name="pw"),
+                        bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        graph = ImitationGraph(main)
+        cp = CompressPass(scope=scope, epoch=1,
+                          data_reader=lambda: iter([]))
+        cp.add_strategy(SensitivePruneStrategy(
+            pruner=RatioPruner(ratios={"*": 0.5}), start_epoch=0,
+            delta_rate=0.5))
+        cp.apply(graph)
+        pruned = np.asarray(scope.get("pw"))
+        assert (pruned == 0).sum() >= pruned.size // 2
+
+
+def test_quantize_transpiler_flow():
+    from paddle_tpu.contrib import QuantizeTranspiler
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4,
+                            param_attr=fluid.ParamAttr(name="qw"),
+                            bias_attr=False)
+    qt = QuantizeTranspiler(weight_bits=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # observers seed their scale state in the active scope, so the
+        # transpile runs after startup (quantization_pass convention)
+        qt.training_transpile(main, startup)
+        types = [op.type for op in main.global_block().desc.ops]
+        assert any("fake_quantize" in t for t in types), types
+        exe.run(main, feed={"x": np.ones((2, 8), np.float32)},
+                fetch_list=[y])
+        qt.freeze_program(main, fluid.CPUPlace(), scope=scope)
+        converted = qt.convert_to_int8(main, fluid.CPUPlace(),
+                                       scope=scope)
+        assert scope.get("qw@INT8") is not None
+        assert np.asarray(scope.get("qw@INT8")).dtype == np.int8
+
+
+def test_stats_and_preprocessing_utils():
+    from paddle_tpu.contrib import memory_usage, op_freq_statistic
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        fluid.layers.fc(input=h, size=4)
+    lo, hi, unit = memory_usage(main, batch_size=32)
+    assert 0 < lo < hi and unit == "GB"
+    uni, adj = op_freq_statistic(main)
+    assert uni["mul"] >= 2
+    assert any("->" in k for k in adj)
+
+
+def test_convert_dist_to_sparse_program():
+    from paddle_tpu.contrib import convert_dist_to_sparse_program
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        fluid.layers.embedding(ids, size=[32, 4], is_sparse=True,
+                               is_distributed=True)
+    local = convert_dist_to_sparse_program(main)
+    ops = [op for op in local.desc.global_block().ops
+           if op.type == "lookup_table"]
+    assert ops and not ops[0].attrs.get("is_distributed")
+    assert ops[0].attrs.get("is_sparse")

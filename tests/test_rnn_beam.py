@@ -180,8 +180,10 @@ class TestBeamSearch:
             pi = fluid.layers.data(name="pi", shape=[1], dtype="int64")
             ps = fluid.layers.data(name="ps", shape=[1], dtype="float32")
             sc = fluid.layers.data(name="sc", shape=[V], dtype="float32")
+            acc = fluid.layers.elementwise_add(sc, ps, axis=0)
             ids, scs, par = fluid.layers.beam_search(
-                pi, ps, sc, beam_size=W, end_id=0)
+                pi, ps, None, acc, beam_size=W, end_id=0,
+                return_parent_idx=True)
         exe = fluid.Executor()
         got_ids, got_scores, got_par = exe.run(
             main, feed={"pi": pre_ids, "ps": pre_scores, "sc": scores},
@@ -225,9 +227,11 @@ class TestBeamSearch:
             # the arrays
             cur_scores = fluid.layers.gather(
                 table_v, fluid.layers.reshape(start, shape=[-1]))
+            acc0 = fluid.layers.elementwise_add(
+                cur_scores, zero_scores, axis=0)
             ids0, scores0, par0 = fluid.layers.beam_search(
-                start, zero_scores, cur_scores, beam_size=W, end_id=end_id,
-                first_step=True)
+                start, zero_scores, None, acc0, beam_size=W,
+                end_id=end_id, return_parent_idx=True, first_step=True)
             fluid.layers.array_write(ids0, i, array=ids_arr)
             fluid.layers.array_write(par0, i, array=par_arr)
             fluid.layers.array_write(scores0, i, array=score_arr)
@@ -240,8 +244,11 @@ class TestBeamSearch:
             with w.block():
                 cur = fluid.layers.gather(
                     table_v, fluid.layers.reshape(pre_ids, shape=[-1]))
+                acc_t = fluid.layers.elementwise_add(
+                    cur, pre_scores, axis=0)
                 ids_t, scores_t, par_t = fluid.layers.beam_search(
-                    pre_ids, pre_scores, cur, beam_size=W, end_id=end_id)
+                    pre_ids, pre_scores, None, acc_t, beam_size=W,
+                    end_id=end_id, return_parent_idx=True)
                 fluid.layers.array_write(ids_t, i, array=ids_arr)
                 fluid.layers.array_write(par_t, i, array=par_arr)
                 fluid.layers.array_write(scores_t, i, array=score_arr)
@@ -251,7 +258,8 @@ class TestBeamSearch:
                 fluid.layers.less_than(x=i, y=limit, cond=cond)
 
             sent_ids, sent_scores = fluid.layers.beam_search_decode(
-                ids_arr, score_arr, par_arr, beam_size=W, end_id=end_id)
+                ids_arr, score_arr, beam_size=W, end_id=end_id,
+                parent_array=par_arr)
 
         exe = fluid.Executor()
         got_ids, got_scores = exe.run(
