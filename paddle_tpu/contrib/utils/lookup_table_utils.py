@@ -35,19 +35,34 @@ def convert_dist_to_sparse_program(program):
 
 
 def _load_table_shards(dirname, table_name):
-    """Assemble a full table from pserver shard checkpoints."""
-    rows = {}
+    """Assemble a full table from pserver shard checkpoints, ordered by
+    each shard's recorded row offset (@SHARD_START, written by
+    distributed/ps.py save_checkpoint) — NOT by checkpoint filename,
+    which permutes rows when port numbers sort differently than the
+    endpoint list."""
+    shards = []
     for fname in sorted(os.listdir(dirname)):
         if not fname.endswith(".npz"):
             continue
         with np.load(os.path.join(dirname, fname)) as data:
-            for key in data.files:
-                if key == table_name or key.startswith(
-                        table_name + "@SHARD"):
-                    rows[fname + key] = data[key]
-    if not rows:
+            if table_name not in data.files:
+                continue
+            start_key = table_name + "@SHARD_START"
+            start = (int(data[start_key]) if start_key in data.files
+                     else None)
+            shards.append((start, fname, data[table_name]))
+    if not shards:
         return None
-    return np.concatenate(list(rows.values()), axis=0)
+    if any(s[0] is None for s in shards):
+        if len(shards) > 1:
+            raise ValueError(
+                "table %r shard checkpoints carry no @SHARD_START "
+                "offsets (pre-round-3 format) — row order across %d "
+                "files is ambiguous; re-save via checkpoint_notify"
+                % (table_name, len(shards)))
+        return shards[0][2]
+    shards.sort(key=lambda s: s[0])
+    return np.concatenate([s[2] for s in shards], axis=0)
 
 
 def load_persistables_for_increment(dirname, executor, program,

@@ -122,15 +122,27 @@ class DataFeeder:
     def decorate_reader(self, reader, multi_devices=False,
                         num_places=None, drop_last=True):
         """Wrap a batch reader into one yielding feed dicts (reference:
-        data_feeder.py decorate_reader)."""
+        data_feeder.py decorate_reader). With ``multi_devices`` and
+        ``drop_last``, trailing chunks smaller than the per-place size
+        are dropped so every device sees uniform batch shapes."""
 
         def __reader_creator__():
             if not multi_devices:
                 for item in reader():
                     yield self.feed(item)
             else:
+                import numpy as np
+
                 for item in reader():
-                    for d in self.feed_parallel([item], num_places):
+                    chunks = list(self.feed_parallel([item], num_places))
+                    if drop_last and chunks:
+                        per = np.asarray(
+                            chunks[0][self.feed_names[0]]).shape[0]
+                        chunks = [
+                            c for c in chunks
+                            if np.asarray(
+                                c[self.feed_names[0]]).shape[0] == per]
+                    for d in chunks:
                         yield d
 
         return __reader_creator__

@@ -141,9 +141,9 @@ class RpcDeadlineError(OSError):
 
 
 def rpc_deadline_seconds():
-    import os
+    from paddle_tpu import flags
 
-    ms = float(os.environ.get("PADDLE_TPU_RPC_DEADLINE_MS", "180000"))
+    ms = float(flags.get_flag("rpc_deadline_ms"))
     return None if ms <= 0 else ms / 1000.0
 
 
@@ -494,6 +494,13 @@ class ParameterServer:
             v = self.scope.get(n)
             if v is not None:
                 arrays[n] = np.asarray(v)
+        # record each table shard's row offset so loaders reassemble in
+        # ROW order, not in checkpoint-filename order
+        for d in self.dist_tables.values():
+            for n in d.get("sliced", []):
+                if n in arrays:
+                    arrays[n + "@SHARD_START"] = np.asarray(
+                        d["start"], np.int64)
         np.savez(self._checkpoint_path(dirname), **arrays)
 
     def load_checkpoint(self, dirname):

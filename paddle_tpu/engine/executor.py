@@ -40,17 +40,17 @@ class Engine:
         # with explicit drop semantics, executor.py:552 + the bounded
         # kernel caches of execution_strategy.h) — a long-lived serving
         # process with drifting shapes must not leak compiled executables.
+        from paddle_tpu import flags
+
         self._cache = collections.OrderedDict()
-        self._cache_capacity = int(os.environ.get(
-            "PADDLE_TPU_EXECUTABLE_CACHE_SIZE", "128"))
+        self._cache_capacity = int(flags.get_flag("executable_cache_size"))
         self._run_counter = 0
         # Debug guard (reference: FLAGS_check_nan_inf,
         # framework/operator.cc:972-982): verify every fetch and persisted
         # state tensor is finite after each step. Whole-step granularity —
         # per-op checking would break XLA fusion; this catches the blast-up
         # at the same user-visible seam.
-        self.check_nan_inf = os.environ.get(
-            "PADDLE_TPU_CHECK_NAN_INF", "0") not in ("0", "", "false")
+        self.check_nan_inf = bool(flags.get_flag("check_nan_inf"))
 
     # -- public ------------------------------------------------------------
     def run_block(

@@ -1,0 +1,113 @@
+"""Unified runtime flags (reference: the gflags-backed FLAGS_* system —
+paddle/fluid/platform/init.cc InitGflags + python/paddle/fluid/__init__.py
+__bootstrap__ reading env into gflags). Every PADDLE_TPU_* knob is
+declared here with its default and help text; values come from (highest
+precedence first) programmatic set_flags, the environment, the default.
+
+Usage, mirroring the reference's fluid.core.globals-style access::
+
+    from paddle_tpu import flags
+    flags.set_flags({"check_nan_inf": True})
+    flags.get_flag("rpc_deadline_ms")
+    flags.describe()          # name -> (value, source, help)
+"""
+
+import os
+
+__all__ = ["DEFS", "get_flag", "set_flags", "reset_flag", "describe",
+           "env_name"]
+
+# name -> (type, default, help)
+DEFS = {
+    "check_nan_inf": (
+        bool, False,
+        "Verify every fetch/state tensor is finite after each step "
+        "(reference: FLAGS_check_nan_inf)."),
+    "executable_cache_size": (
+        int, 128,
+        "LRU capacity of the engine's compiled-executable cache "
+        "(reference: the Executor program cache)."),
+    "rpc_deadline_ms": (
+        float, 180000.0,
+        "Deadline for pserver RPC replies; <=0 disables (reference: "
+        "FLAGS_rpc_deadline)."),
+    "flash_min_seq": (
+        int, 256,
+        "Minimum key length at which fused_attention dispatches to the "
+        "Pallas flash kernels instead of the XLA composition."),
+    "flash_bwd": (
+        str, "",
+        "Backward path for fused_attention: '' = Pallas flash backward "
+        "kernels, 'xla' = recompute-based XLA backward."),
+    "data": (
+        str, "",
+        "Root directory of real dataset files; empty serves synthetic "
+        "data (dataset/ loaders)."),
+    "trace_dir": (
+        str, "",
+        "Profiler trace output directory (profiler.py)."),
+}
+
+_overrides = {}
+_env_backup = {}
+
+
+def env_name(name):
+    return "PADDLE_TPU_" + name.upper()
+
+
+def _parse(typ, raw):
+    if typ is bool:
+        return raw not in ("0", "", "false", "False", False, 0, None)
+    return typ(raw)
+
+
+def get_flag(name):
+    typ, default, _ = DEFS[name]
+    if name in _overrides:
+        return _overrides[name]
+    raw = os.environ.get(env_name(name))
+    if raw is None:
+        return default
+    return _parse(typ, raw)
+
+
+def set_flags(flags_dict):
+    """Programmatic override (reference: fluid.core.globals setter /
+    __bootstrap__). Also mirrors into the environment so subprocesses
+    (dist workers) inherit the setting."""
+    for name, value in flags_dict.items():
+        if name not in DEFS:
+            raise KeyError(
+                "unknown flag %r; known: %s" % (name, sorted(DEFS)))
+        typ = DEFS[name][0]
+        value = _parse(typ, value) if not isinstance(value, typ) else value
+        if name not in _env_backup:
+            _env_backup[name] = os.environ.get(env_name(name))
+        _overrides[name] = value
+        os.environ[env_name(name)] = (
+            ("1" if value else "0") if typ is bool else str(value))
+
+
+def reset_flag(name):
+    """Undo a set_flags override, restoring any pre-existing env value
+    (the documented set_flags > env > default precedence survives)."""
+    _overrides.pop(name, None)
+    prev = _env_backup.pop(name, None)
+    if prev is None:
+        os.environ.pop(env_name(name), None)
+    else:
+        os.environ[env_name(name)] = prev
+
+
+def describe():
+    out = {}
+    for name, (typ, default, help_text) in DEFS.items():
+        if name in _overrides:
+            src = "set_flags"
+        elif env_name(name) in os.environ:
+            src = "env"
+        else:
+            src = "default"
+        out[name] = (get_flag(name), src, help_text)
+    return out

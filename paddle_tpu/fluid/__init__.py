@@ -30,6 +30,8 @@ from paddle_tpu.core_shim import (  # noqa: F401
     LoDTensorArray,
 )
 from paddle_tpu import backward  # noqa: F401
+from paddle_tpu import flags  # noqa: F401
+from paddle_tpu.flags import set_flags  # noqa: F401
 from paddle_tpu import recordio_writer  # noqa: F401
 from paddle_tpu import nets  # noqa: F401
 

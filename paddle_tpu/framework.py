@@ -459,13 +459,19 @@ class Program:
         framework.py Program.parse_from_string). Accepts both the native
         serialization and the reference's binary framework.proto wire
         format (compat importer)."""
-        desc = None
         try:
             desc = ProgramDescData.parse_from_string(binary_str)
-        except Exception:
+        except Exception as native_err:
             from paddle_tpu import compat
 
-            return compat.load_reference_program(binary_str)
+            try:
+                return compat.load_reference_program(binary_str)
+            except Exception as proto_err:
+                raise ValueError(
+                    "parse_from_string: neither the native format (%s) "
+                    "nor the reference framework.proto format (%s) "
+                    "accepted the bytes" % (native_err, proto_err)
+                ) from native_err
         program = Program()
         program.desc = desc
         desc._version_token = 1

@@ -433,9 +433,9 @@ def flash_attention(q, k, v, seq_lens=None, seed=0, causal=False, scale=None,
 
 
 def _use_xla_bwd():
-    import os
+    from paddle_tpu import flags as _flags
 
-    return os.environ.get("PADDLE_TPU_FLASH_BWD", "") == "xla"
+    return _flags.get_flag("flash_bwd") == "xla"
 
 
 def _fa_fwd(q, k, v, seq_lens, seed, causal, scale, rate, block_q, block_k,
@@ -481,10 +481,10 @@ def _on_tpu():
 
 
 def _flash_min_seq():
-    import os
-
     try:
-        return int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "256"))
+        from paddle_tpu import flags as _flags
+
+        return int(_flags.get_flag("flash_min_seq"))
     except ValueError:  # pragma: no cover
         return 256
 

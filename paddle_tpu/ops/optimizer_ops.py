@@ -274,17 +274,33 @@ def ftrl(ctx, ins, attrs):
 
 
 @register_no_grad_op("model_average_accum",
-                     inplace_map={"SumOut": "Sum", "CntOut": "Cnt"})
+                     inplace_map={"SumOut": "Sum", "CntOut": "Cnt",
+                                  "OldSumOut": "OldSum",
+                                  "OldCntOut": "OldCnt",
+                                  "TotalOut": "Total"})
 def model_average_accum(ctx, ins, attrs):
-    """Running parameter sum for ModelAverage (reference:
-    optimizer.py:1484 ModelAverage's sum_1/2/3 + num_accumulates ops,
-    simplified to a single restarting window: history drops every
-    max_average_window steps instead of the reference's 3-tier fold)."""
+    """Windowed parameter sums for ModelAverage (reference:
+    optimizer.py:1484 + operators/average_accumulates_op: the current
+    window folds into the old one when num_accumulates reaches
+    min(max_average_window, num_updates * average_window_rate), so an
+    average is ALWAYS available — apply reads (Sum+OldSum)/(Cnt+OldCnt)).
+    The reference's three-tier fold (sum_1/2/3) is collapsed to two."""
     param = single(ins, "Param")
     s = single(ins, "Sum")
     c = single(ins, "Cnt")
+    old_s = single(ins, "OldSum")
+    old_c = single(ins, "OldCnt")
+    total = single(ins, "Total")
+    rate = float(attrs.get("average_window_rate", 0.15))
+    minw = float(attrs.get("min_average_window", 10000))
     maxw = float(attrs.get("max_average_window", 10000))
-    restart = c >= maxw
-    s2 = jnp.where(restart, param, s + param)
-    c2 = jnp.where(restart, 1.0, c + 1.0)
-    return {"SumOut": [s2], "CntOut": [c2]}
+    total2 = total + 1.0
+    c2 = c + 1.0
+    s2 = s + param
+    restart = (c2 >= minw) & (c2 >= jnp.minimum(maxw, total2 * rate))
+    old_s2 = jnp.where(restart, s2, old_s)
+    old_c2 = jnp.where(restart, c2, old_c)
+    s3 = jnp.where(restart, jnp.zeros_like(s2), s2)
+    c3 = jnp.where(restart, 0.0, c2)
+    return {"SumOut": [s3], "CntOut": [c3], "OldSumOut": [old_s2],
+            "OldCntOut": [old_c2], "TotalOut": [total2]}

@@ -59,6 +59,20 @@ class Optimizer:
     def _create_param_lr(self, param_and_grad):
         param = param_and_grad[0]
         param_lr = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        if isinstance(param_lr, Variable):
+            # a per-param LR variable (e.g. layers.append_LARS writes one)
+            # multiplies the global LR in-program (reference:
+            # optimizer.py _create_param_lr's Variable branch)
+            helper = LayerHelper("param_lr")
+            out = helper.create_variable_for_type_inference(
+                dtype="float32")
+            helper.append_op(
+                type="elementwise_mul",
+                inputs={"X": [self._lr_var], "Y": [param_lr]},
+                outputs={"Out": [out]},
+                attrs={"axis": -1},
+            )
+            return out
         if param_lr == 1.0:
             return self._lr_var
         helper = LayerHelper("param_lr")
@@ -533,14 +547,25 @@ class ModelAverage(Optimizer):
                     continue
                 s = self._add_accumulator("ma_sum", p)
                 c = self._add_accumulator("ma_cnt", p, shape=[1])
+                old_s = self._add_accumulator("ma_old_sum", p)
+                old_c = self._add_accumulator("ma_old_cnt", p, shape=[1])
+                total = self._add_accumulator("ma_total", p, shape=[1])
                 block.append_op(
                     type="model_average_accum",
-                    inputs={"Param": [p], "Sum": [s], "Cnt": [c]},
-                    outputs={"SumOut": [s], "CntOut": [c]},
-                    attrs={"max_average_window": self.max_average_window,
-                           "op_role_var": [p.name]},
+                    inputs={"Param": [p], "Sum": [s], "Cnt": [c],
+                            "OldSum": [old_s], "OldCnt": [old_c],
+                            "Total": [total]},
+                    outputs={"SumOut": [s], "CntOut": [c],
+                             "OldSumOut": [old_s], "OldCntOut": [old_c],
+                             "TotalOut": [total]},
+                    attrs={
+                        "average_window_rate": self.average_window,
+                        "min_average_window": self.min_average_window,
+                        "max_average_window": self.max_average_window,
+                        "op_role_var": [p.name],
+                    },
                 )
-                self._avg_params.append((p, s, c))
+                self._avg_params.append((p, s, c, old_s, old_c))
         self._stash = {}
 
     def minimize(self, loss, **kwargs):
@@ -558,17 +583,23 @@ class ModelAverage(Optimizer):
 
         scope = global_scope()
         self._stash = {}
-        for p, s, c in self._avg_params:
+        for p, s, c, old_s, old_c in self._avg_params:
             cur = scope.get(p.name)
             sv = scope.get(s.name)
             cv = scope.get(c.name)
+            osv = scope.get(old_s.name)
+            ocv = scope.get(old_c.name)
             if cur is None or sv is None or cv is None:
                 continue
             cnt = float(np.asarray(cv).reshape(-1)[0])
-            if cnt < max(self.min_average_window, 1):
+            total_sum = np.asarray(sv)
+            if osv is not None and ocv is not None:
+                cnt += float(np.asarray(ocv).reshape(-1)[0])
+                total_sum = total_sum + np.asarray(osv)
+            if cnt < 1:
                 continue
             self._stash[p.name] = np.asarray(cur).copy()
-            scope.set(p.name, np.asarray(sv) / cnt)
+            scope.set(p.name, total_sum / cnt)
         try:
             yield
         finally:
