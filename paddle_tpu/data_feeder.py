@@ -130,19 +130,24 @@ class DataFeeder:
             if not multi_devices:
                 for item in reader():
                     yield self.feed(item)
-            else:
-                import numpy as np
+                return
+            import numpy as np
 
-                for item in reader():
-                    chunks = list(self.feed_parallel([item], num_places))
-                    if drop_last and chunks:
-                        per = np.asarray(
-                            chunks[0][self.feed_names[0]]).shape[0]
-                        chunks = [
-                            c for c in chunks
-                            if np.asarray(
-                                c[self.feed_names[0]]).shape[0] == per]
-                    for d in chunks:
-                        yield d
+            expected = None  # (num chunks, rows per chunk) of a full batch
+            for item in reader():
+                chunks = list(self.feed_parallel([item], num_places))
+                sizes = [np.asarray(c[self.feed_names[0]]).shape[0]
+                         for c in chunks]
+                if expected is None:
+                    expected = (len(chunks), sizes[0])
+                uniform = (len(chunks) == expected[0]
+                           and all(s == expected[1] for s in sizes))
+                if drop_last and not uniform:
+                    # an incomplete FINAL batch: fewer/smaller chunks
+                    # than the steady state — drop it whole so every
+                    # device always sees uniform shapes in lockstep
+                    continue
+                for d in chunks:
+                    yield d
 
         return __reader_creator__

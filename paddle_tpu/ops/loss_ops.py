@@ -310,3 +310,41 @@ def edit_distance(ctx, ins, attrs):
     if normalized:
         dist = dist / jnp.maximum(r_len.astype(dist.dtype), 1.0)
     return {"Out": [dist.reshape(B, 1)], "SequenceNum": [seq_num]}
+
+
+@register_no_grad_op("softmax_with_cross_entropy_grad")
+def softmax_with_cross_entropy_grad(ctx, ins, attrs):
+    """Direct CE backward: dLogits = (softmax - onehot) * dLoss
+    (reference: softmax_with_cross_entropy_op.h's grad kernel). The
+    generic vjp keeps the fp32 log-softmax of the whole logits tensor as
+    a residual — ~1 GB for BERT's [B*T, 30522] MLM head; recomputing the
+    softmax from the (bf16) logits inside the backward trades one fused
+    softmax for that HBM residency."""
+    logits = single(ins, "Logits")
+    label = single(ins, "Label")
+    g_loss = ins.get("Loss@GRAD", [None])
+    g_loss = g_loss[0] if g_loss else None
+    g_sm = ins.get("Softmax@GRAD", [None])
+    g_sm = g_sm[0] if g_sm else None
+    soft = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    sm = jax.nn.softmax(fp32_accum(logits), axis=-1)
+    grad = jnp.zeros_like(sm)
+    if g_loss is not None:
+        if soft:
+            grad = (sm - fp32_accum(label)) * g_loss
+        else:
+            idx = _squeeze_label(label)
+            onehot = jax.nn.one_hot(idx, logits.shape[-1],
+                                    dtype=sm.dtype)
+            grad = (sm - onehot) * g_loss
+            if ignore_index >= 0:
+                grad = jnp.where((idx == ignore_index)[..., None], 0.0,
+                                 grad)
+    if g_sm is not None:
+        # cotangent through the Softmax output (return_softmax=True
+        # consumers, e.g. distillation): softmax vjp
+        gs = fp32_accum(g_sm)
+        grad = grad + sm * (gs - jnp.sum(gs * sm, axis=-1,
+                                         keepdims=True))
+    return {"Logits@GRAD": [grad.astype(logits.dtype)]}

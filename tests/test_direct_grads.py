@@ -101,3 +101,95 @@ class TestMatmulGrad(OpTest):
             self.check_grad(
                 "matmul", {"X": [("x", x)], "Y": [("y", y)]}, name,
                 attrs={"transpose_X": False, "transpose_Y": False})
+
+
+def test_softmax_with_cross_entropy_direct_grad():
+    """The hand-written CE backward matches the analytic oracle for hard
+    labels (incl. ignore_index); soft labels and the Softmax-output
+    cotangent path are covered by the companion test below."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    rng = np.random.RandomState(0)
+    N, V = 6, 9
+    x = rng.randn(N, V).astype(np.float32)
+    y = rng.randint(0, V, (N, 1)).astype(np.int64)
+    y[2, 0] = 5  # one ignored row below
+
+    def run(ignore_index):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            lg = fluid.layers.data(name="lg", shape=[V],
+                                   dtype="float32")
+            lg.stop_gradient = False
+            lb = fluid.layers.data(name="lb", shape=[1], dtype="int64")
+            loss = fluid.layers.softmax_with_cross_entropy(
+                logits=lg, label=lb, ignore_index=ignore_index)
+            total = fluid.layers.reduce_sum(loss)
+            fluid.append_backward(total)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (gv,) = exe.run(main, feed={"lg": x, "lb": y},
+                            fetch_list=[fluid.grad_var_name("lg")])
+        return np.asarray(gv)
+
+    for ignore in (-100, 5):
+        g = run(ignore)
+        # analytic oracle: dL/dlogits = softmax - onehot, ignored rows 0
+        x64 = x.astype(np.float64)
+        m = x64 - x64.max(1, keepdims=True)
+        sm = np.exp(m) / np.exp(m).sum(1, keepdims=True)
+        onehot = np.eye(V)[y[:, 0]]
+        want = sm - onehot
+        if ignore >= 0:
+            want = np.where((y[:, 0] == ignore)[:, None], 0.0, want)
+        np.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
+
+
+
+def test_softmax_with_cross_entropy_soft_and_softmax_branch():
+    """Soft labels and gradient THROUGH the returned softmax (the
+    distillation pattern) — the direct grad must reproduce what the
+    generic vjp computed for both output cotangents."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    rng = np.random.RandomState(1)
+    N, V = 5, 7
+    x = rng.randn(N, V).astype(np.float32)
+    p_soft = rng.rand(N, V).astype(np.float32)
+    p_soft /= p_soft.sum(1, keepdims=True)
+    w = rng.randn(N, V).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        lg = fluid.layers.data(name="lg", shape=[V], dtype="float32")
+        lg.stop_gradient = False
+        lb = fluid.layers.data(name="lb", shape=[V], dtype="float32")
+        wv = fluid.layers.data(name="wv", shape=[V], dtype="float32")
+        loss, sm = fluid.layers.softmax_with_cross_entropy(
+            logits=lg, label=lb, soft_label=True, return_softmax=True)
+        # total pulls gradient through BOTH outputs
+        total = fluid.layers.reduce_sum(loss) + fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(sm, wv))
+        fluid.append_backward(total)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"lg": x, "lb": p_soft, "wv": w},
+                       fetch_list=[fluid.grad_var_name("lg")])
+    g = np.asarray(g)
+    # analytic: d/dlogits [sum(-p*log_softmax) + sum(w*softmax)]
+    x64 = x.astype(np.float64)
+    m = x64 - x64.max(1, keepdims=True)
+    sm64 = np.exp(m) / np.exp(m).sum(1, keepdims=True)
+    want = (sm64 - p_soft)  # soft CE part (sum over rows, dLoss=1)
+    want = want + sm64 * (w - (w * sm64).sum(1, keepdims=True))
+    np.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
