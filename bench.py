@@ -158,9 +158,12 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=20):
     v = jax.device_put(jnp.asarray(
         rng.randn(batch, heads, seq, dim), jnp.bfloat16))
 
+    from paddle_tpu.kernels.flash_attention import pick_block
+
+    bq = pick_block(seq)
     flash_g = jax.jit(jax.grad(
         lambda a, b, c: jnp.sum(flash_attention(
-            a, b, c, None, 0, True, None, 0.0, 128, 128,
+            a, b, c, None, 0, True, None, 0.0, bq, bq,
             False).astype(jnp.float32)),
         argnums=(0, 1, 2)))
     xla_g = jax.jit(jax.grad(

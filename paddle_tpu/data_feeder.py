@@ -106,6 +106,8 @@ class DataFeeder:
         import numpy as np
 
         for item in iterable:
+            if not item:
+                continue  # empty batch (filtered-out bucket/shard)
             fd = self.feed(item)
             n = num_places or 1
             first = np.asarray(fd[self.feed_names[0]])
@@ -133,19 +135,20 @@ class DataFeeder:
                 return
             import numpy as np
 
-            expected = None  # (num chunks, rows per chunk) of a full batch
+            n = num_places or 1
             for item in reader():
                 chunks = list(self.feed_parallel([item], num_places))
+                if not chunks:
+                    continue
                 sizes = [np.asarray(c[self.feed_names[0]]).shape[0]
                          for c in chunks]
-                if expected is None:
-                    expected = (len(chunks), sizes[0])
-                uniform = (len(chunks) == expected[0]
-                           and all(s == expected[1] for s in sizes))
+                # a batch is complete when it fills every place with
+                # equal-size chunks; validated per batch so bucketed
+                # readers with varying batch sizes still pass — only
+                # batches that cannot split evenly are dropped
+                uniform = (len(chunks) == n
+                           and all(s == sizes[0] for s in sizes))
                 if drop_last and not uniform:
-                    # an incomplete FINAL batch: fewer/smaller chunks
-                    # than the steady state — drop it whole so every
-                    # device always sees uniform shapes in lockstep
                     continue
                 for d in chunks:
                     yield d

