@@ -38,6 +38,42 @@ def _parse_line(line, slots):
     return out
 
 
+def _make_batch_arrays(counts_vals, slots, program, r0, r1):
+    """Feed dict for rows [r0, r1) straight from the NATIVE parser's
+    per-slot (counts, flat values) arrays — no per-row Python lists
+    (reference keeps this path in C++: framework/data_feed.cc
+    MultiSlotDataFeed)."""
+    block = program.global_block()
+    feed = {}
+    B = r1 - r0
+    for si, s in enumerate(slots):
+        counts, vals, offsets = counts_vals[si]
+        if not s.is_used:
+            continue
+        np_t = np.float32 if s.type.startswith("float") else np.int64
+        c = counts[r0:r1]
+        lo, hi = offsets[r0], offsets[r1]
+        flat = vals[lo:hi]
+        if s.is_dense:
+            if B and not (c == c[0]).all():
+                # the Python path's np.asarray(ragged) raises too —
+                # a dense slot with varying counts is malformed data
+                raise ValueError(
+                    "dense slot %r has varying per-row counts" % s.name)
+            feed[s.name] = flat.reshape(B, -1).astype(np_t, copy=False)
+            continue
+        maxlen = bucketed_length(int(c.max()) if B else 1)
+        batch = np.zeros((B, maxlen), np_t)
+        row_off = offsets[r0:r1] - lo
+        for i in range(B):
+            n = int(c[i])
+            batch[i, :n] = flat[row_off[i]:row_off[i] + n]
+        feed[s.name] = batch
+        if block.desc.find_var_recursive(s.name + LENGTH_SUFFIX) is not None:
+            feed[s.name + LENGTH_SUFFIX] = c.astype(np.int64)
+    return feed
+
+
 def _make_batch(rows, slots, program):
     """rows: list of per-slot value lists (ALL slots, parse order) ->
     feed dict of the USED slots (padded + @LEN), like the reference's
@@ -207,9 +243,31 @@ class AsyncExecutor:
 
         def worker(tid):
             try:
+                from paddle_tpu.native import parse_multislot_file
+
                 sums = np.zeros(len(fetch_names))
                 count = 0
                 for fname in filelist[tid::thread_num]:
+                    parsed = parse_multislot_file(
+                        fname,
+                        [s.type.startswith("float") for s in slots])
+                    if parsed is not None:
+                        # native fast path: slice batches from the flat
+                        # per-slot arrays
+                        n_rows, cols = parsed
+                        cv = []
+                        for counts, vals in cols:
+                            offsets = np.zeros(n_rows + 1, np.int64)
+                            np.cumsum(counts, out=offsets[1:])
+                            cv.append((counts, vals, offsets))
+                        for r0 in range(0, n_rows, batch_size):
+                            r1 = min(r0 + batch_size, n_rows)
+                            feed = _make_batch_arrays(
+                                cv, slots, program, r0, r1)
+                            count += 1
+                            sums += self._run_feed(program, scope, feed,
+                                                   fetch_names)
+                        continue
                     rows = []
                     with open(fname) as f:
                         for line in f:
@@ -249,7 +307,11 @@ class AsyncExecutor:
         return list(total / max(n, 1))
 
     def _step(self, program, scope, slots, rows, fetch_names):
-        feed = _make_batch(rows, slots, program)
+        return self._run_feed(program, scope,
+                              _make_batch(rows, slots, program),
+                              fetch_names)
+
+    def _run_feed(self, program, scope, feed, fetch_names):
         outs = self.executor.engine.run_block(
             program.desc, 0, scope, feed=feed, fetch_list=fetch_names,
             is_test=getattr(program, "_is_test", False),

@@ -12,7 +12,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["recordio.cc", "blocking_queue.cc"]
+_SOURCES = ["recordio.cc", "blocking_queue.cc", "multislot.cc"]
 _SO_PATH = os.path.join(_DIR, "libpaddle_tpu_native.so")
 
 _lock = threading.Lock()
@@ -48,6 +48,24 @@ def _bind(lib):
     lib.rio_reader_open.argtypes = [c.c_char_p]
     lib.rio_reader_next.restype = c.c_int64
     lib.rio_reader_next.argtypes = [c.c_void_p, c.POINTER(c.c_char_p)]
+    lib.msf_parse_file.restype = c.c_void_p
+    lib.msf_parse_file.argtypes = [c.c_char_p, c.c_int,
+                                   c.POINTER(c.c_uint8)]
+    lib.msf_num_rows.restype = c.c_int64
+    lib.msf_num_rows.argtypes = [c.c_void_p]
+    lib.msf_slot_total.restype = c.c_int64
+    lib.msf_slot_total.argtypes = [c.c_void_p, c.c_int]
+    lib.msf_slot_counts.restype = None
+    lib.msf_slot_counts.argtypes = [c.c_void_p, c.c_int,
+                                    c.POINTER(c.c_int64)]
+    lib.msf_slot_values_f.restype = None
+    lib.msf_slot_values_f.argtypes = [c.c_void_p, c.c_int,
+                                      c.POINTER(c.c_float)]
+    lib.msf_slot_values_i.restype = None
+    lib.msf_slot_values_i.argtypes = [c.c_void_p, c.c_int,
+                                      c.POINTER(c.c_int64)]
+    lib.msf_free.restype = None
+    lib.msf_free.argtypes = [c.c_void_p]
     lib.rio_reader_close.argtypes = [c.c_void_p]
 
     lib.btq_create.restype = c.c_void_p
@@ -160,3 +178,49 @@ class BlockingQueue:
                 self._native.btq_destroy(self._q)
         except Exception:
             pass
+
+
+def parse_multislot_file(path, slot_is_float):
+    """Parse a MultiSlotDataFeed file natively (reference:
+    framework/data_feed.cc MultiSlotDataFeed). Returns
+    (num_rows, [(counts int64[rows], values np[total]) per slot]) or
+    None when the native lib is unavailable or the file fails to parse
+    (callers fall back to the Python parser)."""
+    import ctypes
+
+    import numpy as np
+
+    l = lib()
+    if l is None:
+        return None
+    n = len(slot_is_float)
+    mask = (ctypes.c_uint8 * n)(*[1 if f else 0 for f in slot_is_float])
+    h = l.msf_parse_file(path.encode(), n, mask)
+    if not h:
+        return None
+    try:
+        rows = l.msf_num_rows(h)
+        out = []
+        for j, is_f in enumerate(slot_is_float):
+            counts = np.empty(rows, np.int64)
+            if rows:
+                l.msf_slot_counts(
+                    h, j, counts.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)))
+            total = l.msf_slot_total(h, j)
+            if is_f:
+                vals = np.empty(total, np.float32)
+                if total:
+                    l.msf_slot_values_f(
+                        h, j, vals.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_float)))
+            else:
+                vals = np.empty(total, np.int64)
+                if total:
+                    l.msf_slot_values_i(
+                        h, j, vals.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int64)))
+            out.append((counts, vals))
+        return rows, out
+    finally:
+        l.msf_free(h)
