@@ -32,28 +32,33 @@ def _parse_line(line, slots):
     for s in slots:
         n = int(vals[i])
         i += 1
+        if i + n > len(vals):
+            raise ValueError(
+                "MultiSlot line truncated: slot %r declares %d values, "
+                "line has %d tokens left" % (s.name if hasattr(
+                    s, "name") else "?", n, len(vals) - i))
         conv = float if s.type.startswith("float") else int
         out.append([conv(v) for v in vals[i:i + n]])
         i += n
+    if i != len(vals):
+        raise ValueError(
+            "MultiSlot line has %d trailing tokens" % (len(vals) - i))
     return out
 
 
-def _make_batch_arrays(counts_vals, slots, program, r0, r1):
-    """Feed dict for rows [r0, r1) straight from the NATIVE parser's
-    per-slot (counts, flat values) arrays — no per-row Python lists
-    (reference keeps this path in C++: framework/data_feed.cc
-    MultiSlotDataFeed)."""
+def _make_batch_arrays(msf, slots, program, r0, r1):
+    """Feed dict for rows [r0, r1) copied straight from the NATIVE
+    parser's handle — one batch at a time, no whole-file numpy
+    duplicate (reference keeps this path in C++:
+    framework/data_feed.cc MultiSlotDataFeed)."""
     block = program.global_block()
     feed = {}
     B = r1 - r0
     for si, s in enumerate(slots):
-        counts, vals, offsets = counts_vals[si]
         if not s.is_used:
             continue
         np_t = np.float32 if s.type.startswith("float") else np.int64
-        c = counts[r0:r1]
-        lo, hi = offsets[r0], offsets[r1]
-        flat = vals[lo:hi]
+        c, flat = msf.slot_batch(si, r0, r1)
         if s.is_dense:
             if B and not (c == c[0]).all():
                 # the Python path's np.asarray(ragged) raises too —
@@ -64,10 +69,11 @@ def _make_batch_arrays(counts_vals, slots, program, r0, r1):
             continue
         maxlen = bucketed_length(int(c.max()) if B else 1)
         batch = np.zeros((B, maxlen), np_t)
-        row_off = offsets[r0:r1] - lo
+        off = 0
         for i in range(B):
             n = int(c[i])
-            batch[i, :n] = flat[row_off[i]:row_off[i] + n]
+            batch[i, :n] = flat[off:off + n]
+            off += n
         feed[s.name] = batch
         if block.desc.find_var_recursive(s.name + LENGTH_SUFFIX) is not None:
             feed[s.name + LENGTH_SUFFIX] = c.astype(np.int64)
@@ -243,30 +249,25 @@ class AsyncExecutor:
 
         def worker(tid):
             try:
-                from paddle_tpu.native import parse_multislot_file
+                from paddle_tpu.native import open_multislot_file
 
                 sums = np.zeros(len(fetch_names))
                 count = 0
                 for fname in filelist[tid::thread_num]:
-                    parsed = parse_multislot_file(
+                    msf = open_multislot_file(
                         fname,
                         [s.type.startswith("float") for s in slots])
-                    if parsed is not None:
-                        # native fast path: slice batches from the flat
-                        # per-slot arrays
-                        n_rows, cols = parsed
-                        cv = []
-                        for counts, vals in cols:
-                            offsets = np.zeros(n_rows + 1, np.int64)
-                            np.cumsum(counts, out=offsets[1:])
-                            cv.append((counts, vals, offsets))
-                        for r0 in range(0, n_rows, batch_size):
-                            r1 = min(r0 + batch_size, n_rows)
-                            feed = _make_batch_arrays(
-                                cv, slots, program, r0, r1)
-                            count += 1
-                            sums += self._run_feed(program, scope, feed,
-                                                   fetch_names)
+                    if msf is not None:
+                        # native fast path: one batch copied out of the
+                        # C++ handle at a time
+                        with msf:
+                            for r0 in range(0, msf.rows, batch_size):
+                                r1 = min(r0 + batch_size, msf.rows)
+                                feed = _make_batch_arrays(
+                                    msf, slots, program, r0, r1)
+                                count += 1
+                                sums += self._run_feed(
+                                    program, scope, feed, fetch_names)
                         continue
                     rows = []
                     with open(fname) as f:

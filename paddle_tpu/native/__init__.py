@@ -66,6 +66,19 @@ def _bind(lib):
                                       c.POINTER(c.c_int64)]
     lib.msf_free.restype = None
     lib.msf_free.argtypes = [c.c_void_p]
+    lib.msf_range_total.restype = c.c_int64
+    lib.msf_range_total.argtypes = [c.c_void_p, c.c_int, c.c_int64,
+                                    c.c_int64]
+    lib.msf_counts_range.restype = None
+    lib.msf_counts_range.argtypes = [c.c_void_p, c.c_int, c.c_int64,
+                                     c.c_int64, c.POINTER(c.c_int64)]
+    lib.msf_values_f_range.restype = None
+    lib.msf_values_f_range.argtypes = [c.c_void_p, c.c_int, c.c_int64,
+                                       c.c_int64, c.POINTER(c.c_float)]
+    lib.msf_values_i_range.restype = None
+    lib.msf_values_i_range.argtypes = [c.c_void_p, c.c_int, c.c_int64,
+                                       c.c_int64,
+                                       c.POINTER(c.c_int64)]
     lib.rio_reader_close.argtypes = [c.c_void_p]
 
     lib.btq_create.restype = c.c_void_p
@@ -181,14 +194,78 @@ class BlockingQueue:
 
 
 def parse_multislot_file(path, slot_is_float):
-    """Parse a MultiSlotDataFeed file natively (reference:
-    framework/data_feed.cc MultiSlotDataFeed). Returns
-    (num_rows, [(counts int64[rows], values np[total]) per slot]) or
-    None when the native lib is unavailable or the file fails to parse
-    (callers fall back to the Python parser)."""
-    import ctypes
+    """Whole-file convenience over open_multislot_file (tests): returns
+    (num_rows, [(counts, values) per slot]) or None."""
+    mf = open_multislot_file(path, slot_is_float)
+    if mf is None:
+        return None
+    with mf:
+        return mf.rows, [mf.slot_batch(j, 0, mf.rows)
+                         for j in range(len(slot_is_float))]
 
-    import numpy as np
+
+class MultiSlotFile:
+    """Handle over a natively-parsed slot file; batches are copied out
+    one row-range at a time (the parsed data lives once in the C++
+    vectors — no whole-file numpy duplicate). Use as a context manager
+    or call close()."""
+
+    def __init__(self, handle, n_slots, slot_is_float):
+        self._h = handle
+        self._n = n_slots
+        self._is_float = list(slot_is_float)
+        self.rows = lib().msf_num_rows(handle)
+
+    def slot_batch(self, j, r0, r1):
+        """(counts int64[r1-r0], values np[range total]) for slot j."""
+        import ctypes
+
+        import numpy as np
+
+        l = lib()
+        counts = np.empty(r1 - r0, np.int64)
+        if r1 > r0:
+            l.msf_counts_range(
+                self._h, j, r0, r1,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        total = l.msf_range_total(self._h, j, r0, r1)
+        if self._is_float[j]:
+            vals = np.empty(total, np.float32)
+            if total:
+                l.msf_values_f_range(
+                    self._h, j, r0, r1,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        else:
+            vals = np.empty(total, np.int64)
+            if total:
+                l.msf_values_i_range(
+                    self._h, j, r0, r1,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return counts, vals
+
+    def close(self):
+        if self._h is not None:
+            lib().msf_free(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def open_multislot_file(path, slot_is_float):
+    """Parse a MultiSlotDataFeed file natively; returns a MultiSlotFile
+    handle or None (no toolchain / parse error -> Python fallback)."""
+    import ctypes
 
     l = lib()
     if l is None:
@@ -198,29 +275,4 @@ def parse_multislot_file(path, slot_is_float):
     h = l.msf_parse_file(path.encode(), n, mask)
     if not h:
         return None
-    try:
-        rows = l.msf_num_rows(h)
-        out = []
-        for j, is_f in enumerate(slot_is_float):
-            counts = np.empty(rows, np.int64)
-            if rows:
-                l.msf_slot_counts(
-                    h, j, counts.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_int64)))
-            total = l.msf_slot_total(h, j)
-            if is_f:
-                vals = np.empty(total, np.float32)
-                if total:
-                    l.msf_slot_values_f(
-                        h, j, vals.ctypes.data_as(
-                            ctypes.POINTER(ctypes.c_float)))
-            else:
-                vals = np.empty(total, np.int64)
-                if total:
-                    l.msf_slot_values_i(
-                        h, j, vals.ctypes.data_as(
-                            ctypes.POINTER(ctypes.c_int64)))
-            out.append((counts, vals))
-        return rows, out
-    finally:
-        l.msf_free(h)
+    return MultiSlotFile(h, n, slot_is_float)

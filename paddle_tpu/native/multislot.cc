@@ -6,6 +6,7 @@
 // Line format (reference data_feed.cc): per slot, a count N followed by
 // N values, repeated for every slot in declaration order.
 
+#include <cctype>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -18,6 +19,7 @@ namespace {
 struct SlotData {
   bool is_float = false;
   std::vector<int64_t> counts;   // per row
+  std::vector<int64_t> offsets;  // prefix sums of counts ([rows+1])
   std::vector<float> fvals;      // when is_float
   std::vector<int64_t> ivals;    // otherwise
 };
@@ -69,7 +71,13 @@ void* msf_parse_file(const char* path, int n_slots,
     for (int j = 0; j < n_slots && row_ok; ++j) {
       char* next = nullptr;
       long long n = std::strtoll(lp, &next, 10);
-      if (next == lp || n < 0) { row_ok = false; break; }
+      // the count must be a WHOLE integer token — "2.5" must fail, not
+      // parse as count 2 with ".5" becoming the first value
+      if (next == lp || n < 0 ||
+          (next < lend && !std::isspace(static_cast<unsigned char>(*next)))) {
+        row_ok = false;
+        break;
+      }
       lp = next;
       SlotData& sd = mf->slots[static_cast<size_t>(j)];
       sd.counts.push_back(n);
@@ -90,6 +98,12 @@ void* msf_parse_file(const char* path, int n_slots,
     if (!row_ok) { delete mf; return nullptr; }
     mf->rows += 1;
     p = line_end;
+  }
+  for (auto& sd : mf->slots) {
+    sd.offsets.resize(sd.counts.size() + 1);
+    sd.offsets[0] = 0;
+    for (size_t i = 0; i < sd.counts.size(); ++i)
+      sd.offsets[i + 1] = sd.offsets[i] + sd.counts[i];
   }
   return mf;
 }
@@ -117,6 +131,39 @@ void msf_slot_values_f(void* h, int j, float* out) {
 void msf_slot_values_i(void* h, int j, int64_t* out) {
   SlotData& sd = static_cast<MsfFile*>(h)->slots[static_cast<size_t>(j)];
   std::memcpy(out, sd.ivals.data(), sd.ivals.size() * sizeof(int64_t));
+}
+
+// Range-based copies: Python slices one BATCH of rows at a time instead
+// of materializing whole-file numpy duplicates of the parsed vectors.
+int64_t msf_range_total(void* h, int j, int64_t r0, int64_t r1) {
+  SlotData& sd = static_cast<MsfFile*>(h)->slots[static_cast<size_t>(j)];
+  return sd.offsets[static_cast<size_t>(r1)]
+       - sd.offsets[static_cast<size_t>(r0)];
+}
+
+void msf_counts_range(void* h, int j, int64_t r0, int64_t r1,
+                      int64_t* out) {
+  SlotData& sd = static_cast<MsfFile*>(h)->slots[static_cast<size_t>(j)];
+  std::memcpy(out, sd.counts.data() + r0,
+              static_cast<size_t>(r1 - r0) * sizeof(int64_t));
+}
+
+void msf_values_f_range(void* h, int j, int64_t r0, int64_t r1,
+                        float* out) {
+  SlotData& sd = static_cast<MsfFile*>(h)->slots[static_cast<size_t>(j)];
+  int64_t lo = sd.offsets[static_cast<size_t>(r0)];
+  int64_t hi = sd.offsets[static_cast<size_t>(r1)];
+  std::memcpy(out, sd.fvals.data() + lo,
+              static_cast<size_t>(hi - lo) * sizeof(float));
+}
+
+void msf_values_i_range(void* h, int j, int64_t r0, int64_t r1,
+                        int64_t* out) {
+  SlotData& sd = static_cast<MsfFile*>(h)->slots[static_cast<size_t>(j)];
+  int64_t lo = sd.offsets[static_cast<size_t>(r0)];
+  int64_t hi = sd.offsets[static_cast<size_t>(r1)];
+  std::memcpy(out, sd.ivals.data() + lo,
+              static_cast<size_t>(hi - lo) * sizeof(int64_t));
 }
 
 void msf_free(void* h) { delete static_cast<MsfFile*>(h); }

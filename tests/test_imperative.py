@@ -191,3 +191,42 @@ def test_nn_prototypes():
         loss = fluid.layers.reduce_sum(y)
         loss._backward()
         assert c._filter_param._gradient().shape == (4, 3, 3, 3)
+
+
+def test_imperative_conv_net_trains():
+    """Eager training loop (reference: test_imperative_mnist.py scope):
+    forward through imperative Conv2D/Pool2D/FC, loss._backward(), manual
+    SGD on the parameter values in the tracer env — convergence without
+    ever building a static program."""
+    from paddle_tpu.framework import _imperative_tracer
+    from paddle_tpu.imperative.nn import FC, Conv2D, Pool2D
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(64, 4).astype(np.float32)
+
+    with fluid.imperative.guard():
+        conv = Conv2D(1, 4, 3, padding=1, act="relu")
+        pool = Pool2D(pool_size=2, pool_stride=2)
+        fc = FC(4)
+        losses = []
+        for step in range(30):
+            xv = rng.randn(16, 64).astype(np.float32)
+            yv = np.argmax(xv @ W, 1).astype(np.int64).reshape(-1, 1)
+            img = fluid.imperative.to_variable(
+                xv.reshape(16, 1, 8, 8))
+            label = fluid.imperative.to_variable(yv)
+            h = pool(conv(img))
+            logits = fc(h)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    logits=logits, label=label))
+            loss._backward()
+            losses.append(float(loss._numpy()))
+            # manual SGD over every parameter that has a gradient
+            env = _imperative_tracer().env
+            for p in (conv.parameters() + fc.parameters()):
+                g = env.get(fluid.grad_var_name(p.name))
+                if g is not None:
+                    env[p.name] = env[p.name] - 0.05 * g
+                p._clear_gradient()
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
