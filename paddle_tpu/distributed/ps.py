@@ -133,7 +133,19 @@ def _recv_msg(sock, idle_ok=False):
     return _decode_msg(body)
 
 
-class RpcDeadlineError(OSError):
+class RpcError(OSError):
+    """Typed RPC failure. Peer death is an error the CALLER sees, never a
+    bare TypeError in a worker thread (reference: the completion-queue
+    status handling of operators/distributed/grpc/grpc_client.cc — a dead
+    peer becomes a failed RPC with a message naming the peer)."""
+
+
+class RpcPeerClosedError(RpcError):
+    """The peer closed the connection mid-RPC (EOF before a full reply
+    frame arrived)."""
+
+
+class RpcDeadlineError(RpcError):
     """A peer failed to answer within PADDLE_TPU_RPC_DEADLINE_MS
     (reference: FLAGS_rpc_deadline + the completion-queue timeouts of
     operators/distributed/grpc/grpc_client.cc:64 — a hung peer must fail
@@ -291,6 +303,8 @@ class ParameterServer:
                 _send_msg(conn, ("error", "protocol error: %s" % e))
             except OSError:
                 pass
+        except OSError:
+            pass  # peer vanished mid-frame; nothing to reply to
         finally:
             conn.close()
 
@@ -299,96 +313,116 @@ class ParameterServer:
             msg = _recv_msg(conn, idle_ok=True)
             if msg is None:
                 return
-            kind = msg[0]
-            if kind == "send":
-                _, name, arr = msg
-                if self.sync_mode:
-                    with self._lock:
-                        self._grads.setdefault(name, []).append(arr)
-                else:
-                    # RunAsyncLoop: apply this trainer's gradient now
-                    # (serialized by the lock — the consistency level of
-                    # the reference's per-block executor, without
-                    # cross-trainer barriers)
-                    with self._lock:
-                        self._apply_async_dense(name, arr)
-                _send_msg(conn, ("ok",))
-            elif kind == "send_sparse":
-                _, name, rows, values = msg
-                if self.sync_mode:
-                    with self._lock:
-                        self._sparse_grads.setdefault(name, []).append(
-                            (rows, values))
-                else:
-                    with self._lock:
-                        self._apply_sparse(name, [(rows, values)], scale=1.0)
-                _send_msg(conn, ("ok",))
-            elif kind == "checkpoint":
-                # reference: checkpoint_notify_op.cc:28 — each pserver
-                # saves its own shard of the persistables
-                _, dirname = msg
-                try:
-                    with self._lock:
-                        self.save_checkpoint(dirname)
-                    _send_msg(conn, ("ok",))
-                except OSError as e:
-                    _send_msg(conn, ("error", "checkpoint failed: %s" % e))
-            elif kind == "prefetch":
-                # shard-local row gather (reference:
-                # request_handler_impl.cc RequestPrefetchHandler); gather
-                # BEFORE np.asarray so a device-resident table transfers
-                # only the requested rows, not the whole shard
-                _, name, ids = msg
-                table = self.scope.get(name)
-                rows = np.asarray(table[ids.astype(np.int64)])
-                _send_msg(conn, ("var", rows))
-            elif kind == "batch_barrier":
-                if not self.sync_mode:
-                    # async mode has no barriers (RunAsyncLoop)
-                    _send_msg(conn, ("ok",))
-                    continue
-                failed = False
+            try:
+                if self._dispatch(conn, msg):
+                    return
+            except OSError:
+                raise
+            except Exception as e:
+                # A handler failure (optimizer block crash, missing var,
+                # compile-cache hiccup under load) is THIS request's
+                # failure, not the connection's: reply with a typed error
+                # the client raises as RpcError, and keep serving. The
+                # reference returns a failed grpc::Status per call
+                # (request_handler_impl.cc), never tears down the channel.
+                _send_msg(conn, ("error", "%s: %s" % (type(e).__name__, e)))
+
+    def _dispatch(self, conn, msg):
+        """Handle one request; returns True when the connection is done."""
+        kind = msg[0]
+        if kind == "send":
+            _, name, arr = msg
+            if self.sync_mode:
                 with self._lock:
-                    self._barriers += 1
-                    gen = self._updated_batch
-                    if self._barriers == self.fanin:
-                        try:
-                            self._run_update()
-                            self._updated_batch += 1
-                        except Exception:
-                            # An update failure while peers are parked in
-                            # the wait loop below must not leave the
-                            # barrier stuck at fanin — stop the server so
-                            # every trainer unblocks; the un-bumped
-                            # generation tells them it failed.
-                            self._stop = True
-                            failed = True
-                        self._barriers = 0
-                        self._lock.notify_all()
-                    else:
-                        while (self._updated_batch == gen
-                               and not self._stop):
-                            self._lock.wait(timeout=5)
-                        failed = self._stop and self._updated_batch == gen
-                if failed:
-                    _send_msg(conn, ("error", "parameter update failed"))
-                else:
-                    _send_msg(conn, ("ok",))
-            elif kind == "get":
-                _, name = msg
-                val = self.scope.get(name)
-                _send_msg(conn, ("var", np.asarray(val)))
-            elif kind == "complete":
-                with self._lock:
-                    self._completed += 1
-                    if self._completed >= self.fanin:
-                        self._stop = True
-                        self._lock.notify_all()
-                _send_msg(conn, ("ok",))
-                conn.close()
-                return
+                    self._grads.setdefault(name, []).append(arr)
             else:
-                _send_msg(conn, ("error", "unknown request %r" % kind))
+                # RunAsyncLoop: apply this trainer's gradient now
+                # (serialized by the lock — the consistency level of
+                # the reference's per-block executor, without
+                # cross-trainer barriers)
+                with self._lock:
+                    self._apply_async_dense(name, arr)
+            _send_msg(conn, ("ok",))
+        elif kind == "send_sparse":
+            _, name, rows, values = msg
+            if self.sync_mode:
+                with self._lock:
+                    self._sparse_grads.setdefault(name, []).append(
+                        (rows, values))
+            else:
+                with self._lock:
+                    self._apply_sparse(name, [(rows, values)], scale=1.0)
+            _send_msg(conn, ("ok",))
+        elif kind == "checkpoint":
+            # reference: checkpoint_notify_op.cc:28 — each pserver
+            # saves its own shard of the persistables
+            _, dirname = msg
+            try:
+                with self._lock:
+                    self.save_checkpoint(dirname)
+                _send_msg(conn, ("ok",))
+            except OSError as e:
+                _send_msg(conn, ("error", "checkpoint failed: %s" % e))
+        elif kind == "prefetch":
+            # shard-local row gather (reference:
+            # request_handler_impl.cc RequestPrefetchHandler); gather
+            # BEFORE np.asarray so a device-resident table transfers
+            # only the requested rows, not the whole shard
+            _, name, ids = msg
+            table = self.scope.get(name)
+            rows = np.asarray(table[ids.astype(np.int64)])
+            _send_msg(conn, ("var", rows))
+        elif kind == "batch_barrier":
+            if not self.sync_mode:
+                # async mode has no barriers (RunAsyncLoop)
+                _send_msg(conn, ("ok",))
+                return False
+            failed = False
+            with self._lock:
+                self._barriers += 1
+                gen = self._updated_batch
+                if self._barriers == self.fanin:
+                    try:
+                        self._run_update()
+                        self._updated_batch += 1
+                    except Exception:
+                        # An update failure while peers are parked in
+                        # the wait loop below must not leave the
+                        # barrier stuck at fanin — stop the server so
+                        # every trainer unblocks; the un-bumped
+                        # generation tells them it failed.
+                        self._stop = True
+                        failed = True
+                    self._barriers = 0
+                    self._lock.notify_all()
+                else:
+                    while (self._updated_batch == gen
+                           and not self._stop):
+                        self._lock.wait(timeout=5)
+                    failed = self._stop and self._updated_batch == gen
+            if failed:
+                _send_msg(conn, ("error", "parameter update failed"))
+            else:
+                _send_msg(conn, ("ok",))
+        elif kind == "get":
+            _, name = msg
+            val = self.scope.get(name)
+            if val is None:
+                raise KeyError("var %r not hosted on %s"
+                               % (name, self.endpoint))
+            _send_msg(conn, ("var", np.asarray(val)))
+        elif kind == "complete":
+            with self._lock:
+                self._completed += 1
+                if self._completed >= self.fanin:
+                    self._stop = True
+                    self._lock.notify_all()
+            _send_msg(conn, ("ok",))
+            conn.close()
+            return True
+        else:
+            _send_msg(conn, ("error", "unknown request %r" % kind))
+        return False
 
     def _run_update(self):
         """Average buffered grads, run each optimizer sub-block
@@ -528,49 +562,72 @@ class PSClient:
             s = socket.create_connection((host, int(port)), timeout=60)
             self._socks[ep] = s
 
+    def _reply(self, ep, expect, idle_ok=False):
+        """One reply frame, or a typed RpcError. EOF (server died or shut
+        the connection mid-RPC) and wrong-kind replies both name the peer
+        so the failure is diagnosable from the trainer side."""
+        msg = _recv_msg(self._socks[ep], idle_ok=idle_ok)
+        if msg is None:
+            raise RpcPeerClosedError(
+                "pserver %s closed the connection before replying" % ep)
+        if msg[0] == "error":
+            raise RpcError("pserver %s: %s" % (ep, msg[1]))
+        if msg[0] != expect:
+            raise RpcError("pserver %s replied %r, expected %r"
+                           % (ep, msg[0], expect))
+        return msg
+
+    def _fanout_replies(self, expect, idle_ok=False):
+        """Drain one reply from EVERY endpoint before raising, so one
+        server's failure cannot leave another's unread reply on the wire
+        and desync that connection for every later RPC."""
+        errors = []
+        for ep in self._socks:
+            try:
+                self._reply(ep, expect, idle_ok=idle_ok)
+            except OSError as e:
+                errors.append(e)
+        if errors:
+            if len(errors) == 1:
+                raise errors[0]
+            raise RpcError("; ".join(str(e) for e in errors))
+
     def send_var(self, ep, name, arr):
         _send_msg(self._socks[ep], ("send", name, np.asarray(arr)))
-        assert _recv_msg(self._socks[ep])[0] == "ok"
+        self._reply(ep, "ok")
 
     def batch_barrier(self):
         for s in self._socks.values():
             _send_msg(s, ("batch_barrier",))
-        for s in self._socks.values():
-            # barrier completion waits on the SLOWEST peer trainer (a
-            # straggler's first-step compile can exceed any RPC deadline)
-            # — unbounded like the reference's sync barrier
-            assert _recv_msg(s, idle_ok=True)[0] == "ok"
+        # barrier completion waits on the SLOWEST peer trainer (a
+        # straggler's first-step compile can exceed any RPC deadline)
+        # — unbounded like the reference's sync barrier
+        self._fanout_replies("ok", idle_ok=True)
 
     def get_var(self, ep, name):
         _send_msg(self._socks[ep], ("get", name))
-        kind, val = _recv_msg(self._socks[ep])
-        assert kind == "var"
-        return val
+        return self._reply(ep, "var")[1]
 
     def prefetch(self, ep, name, local_ids):
         """Rows of a table shard by shard-local id (reference:
         parameter_prefetch.cc prefetch_recv)."""
         _send_msg(self._socks[ep],
                   ("prefetch", name, np.asarray(local_ids, np.int64)))
-        kind, val = _recv_msg(self._socks[ep])
-        assert kind == "var", val
-        return val
+        return self._reply(ep, "var")[1]
 
     def send_sparse(self, ep, name, local_rows, values):
         _send_msg(self._socks[ep],
                   ("send_sparse", name,
                    np.asarray(local_rows, np.int64),
                    np.asarray(values)))
-        assert _recv_msg(self._socks[ep])[0] == "ok"
+        self._reply(ep, "ok")
 
     def checkpoint_notify(self, dirname):
         """Ask every pserver to save its shard (reference:
         checkpoint_notify_op.cc:28)."""
         for s in self._socks.values():
             _send_msg(s, ("checkpoint", dirname))
-        for s in self._socks.values():
-            reply = _recv_msg(s)
-            assert reply is not None and reply[0] == "ok", reply
+        self._fanout_replies("ok")
 
     def send_complete(self):
         for s in self._socks.values():

@@ -206,13 +206,13 @@ def load_inference_model(dirname, executor, model_filename=None,
             for ep in pserver_endpoints:
                 try:
                     val = client.get_var(ep, name)
-                except (OSError, AssertionError):
+                except OSError:
+                    # a server raises a typed RpcError for names it does
+                    # not own (e.g. sliced params living under block
+                    # names) — keep the disk-loaded value then
                     continue
-                # a server answers ('var', None-array) for names it does
-                # not own (e.g. sliced params living under block names) —
-                # keep the disk-loaded value then
                 arr = np.asarray(val)
-                if arr.dtype == object or arr.ndim == 0:
+                if arr.ndim == 0:
                     continue
                 scope.set(name, arr)
                 break
