@@ -230,3 +230,33 @@ def test_imperative_conv_net_trains():
                     env[p.name] = env[p.name] - 0.05 * g
                 p._clear_gradient()
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_layer_attribute_rebinding():
+    """Rebinding a Layer attribute across kinds (Parameter -> sublayer ->
+    plain value) must evict the stale registry entry, so parameters()/
+    sublayers() never resurface a dead object (round-4 review finding)."""
+    from paddle_tpu import framework
+    from paddle_tpu.imperative.layers import Layer
+
+    with fluid.imperative.guard():
+        fc = fluid.imperative.nn.FC(3)
+        fc(fluid.imperative.to_variable(np.ones((2, 5), np.float32)))
+        param = fc._w
+
+        holder = Layer()
+        holder.x = param
+        assert len(holder.parameters()) == 1
+        holder.x = fluid.imperative.nn.FC(2)
+        assert len(holder.parameters()) == 0, "stale Parameter survived"
+        assert len(holder.sublayers()) == 1
+        assert not isinstance(holder.x, framework.Parameter)
+        holder.x = None
+        assert holder.x is None and len(holder.sublayers()) == 0
+        del holder.x
+        assert not hasattr(holder, "x")
+        # assigning a Parameter onto a slot name must not destroy the
+        # registry itself
+        other = Layer()
+        other._parameters = param
+        assert isinstance(other.__dict__["_parameters"], dict)
