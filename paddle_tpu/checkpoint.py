@@ -1,0 +1,231 @@
+"""Async sharded checkpointing (SURVEY §5: the TPU-native equivalent of
+the reference's save-op machinery — python/paddle/fluid/io.py:441
+save_persistables + operators/save_combine_op.cc — re-designed as a
+tensorstore-style background writer instead of save ops on the step
+thread).
+
+Why async is nearly free here: the "snapshot" phase on the step thread
+dispatches one on-device copy per var and returns — copies are enqueued
+on the device stream BEFORE the next step's donation can invalidate the
+source buffers (the engine donates state buffers into the jitted step),
+and the ~ms HBM copy never waits for the device->host transfer. The
+transfer and file writes then run on a background thread while training
+continues; host numpy values are captured by reference (nothing mutates
+them — scope.set rebinds).
+
+Layout of one checkpoint (written under a temp dir, atomically renamed):
+
+    <root>/step_<N>/
+        manifest.json     {"step": N, "vars": {name: {"file", "dtype",
+                           "global_shape", "index"}}, "process": p}
+        <var>.npy         one file per var (per addressable shard when
+                          the array is sharded over a mesh)
+
+``index`` records each saved piece's slice into the global shape, so a
+multi-host restore can reassemble exactly like the reference's sliced
+pserver checkpoints (distributed/ps.py does the same with @SHARD_START).
+"""
+
+import json
+import os
+import re
+import shutil
+import threading
+
+import numpy as np
+
+__all__ = ["CheckpointManager"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _save_synced(path, arr):
+    """np.save + fsync: the atomic-rename publication is only crash-safe
+    if the DATA pages are durable before the rename, not just the
+    manifest."""
+    with open(path, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _slice_index(shard, global_shape):
+    """[(start, stop), ...] per dim of a jax Shard's slice into the
+    global array."""
+    out = []
+    for dim, sl in enumerate(shard.index):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = (global_shape[dim] if sl.stop is None else int(sl.stop))
+        out.append((start, stop))
+    return out
+
+
+class CheckpointManager:
+    """Background-thread checkpoint writer with atomic publication.
+
+    save() captures array references and returns immediately; the
+    transfer + write happens on a daemon thread. A checkpoint directory
+    appears under its final name only when complete (write to
+    ``.tmp_step_N``, fsync, ``os.rename``) — a crash mid-save can never
+    publish a half checkpoint, the property the reference gets from
+    writing params into place one save op at a time and loses on crash.
+    """
+
+    def __init__(self, root, max_to_keep=3, process_index=0):
+        self.root = root
+        self.max_to_keep = max_to_keep
+        self.process_index = process_index
+        os.makedirs(root, exist_ok=True)
+        self._thread = None
+        self._error = None
+        self._lock = threading.Lock()
+
+    # -- save --------------------------------------------------------------
+    def save(self, step, arrays, blocking=False):
+        """``arrays``: {name: array-like}. Captures a snapshot now, writes
+        in the background. One save is in flight at a time: if the
+        PREVIOUS save is still writing, this call first joins it (so a
+        checkpoint interval shorter than the write time degrades to
+        synchronous saving rather than piling up threads). Raises any
+        previous save's error (like orbax: a failed async save surfaces
+        on the next interaction)."""
+        self.check_error()
+        self.wait()                      # one in-flight save at a time
+        snapshot = {}
+        for name, arr in arrays.items():
+            # jax arrays: async on-device copy (the original may be a
+            # DONATED buffer the next training step deletes); host
+            # values: reference capture
+            snapshot[name] = (arr.copy()
+                              if hasattr(arr, "addressable_shards")
+                              else arr)
+        t = threading.Thread(
+            target=self._write, args=(int(step), snapshot), daemon=True)
+        with self._lock:
+            self._thread = t
+        t.start()
+        if blocking:
+            self.wait()
+            self.check_error()
+
+    def _write(self, step, snapshot):
+        try:
+            tmp = os.path.join(self.root, ".tmp_step_%d" % step)
+            final = os.path.join(self.root, "step_%d" % step)
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            manifest = {"step": step, "process": self.process_index,
+                        "vars": {}}
+            for name, arr in snapshot.items():
+                shards = getattr(arr, "addressable_shards", None)
+                fname = name.replace("/", "__")
+                shards = [] if shards is None else list(shards)
+                # dedup by slice index: a dp-replicated param has N
+                # identical full-range shards — save ONE piece, not N
+                # copies of the whole array
+                uniq = {}
+                for sh in shards:
+                    uniq.setdefault(
+                        tuple(map(tuple, _slice_index(sh, arr.shape))),
+                        sh)
+                if len(uniq) > 1:
+                    for sh in uniq.values():
+                        idx = _slice_index(sh, arr.shape)
+                        piece = np.asarray(sh.data)   # D2H here
+                        pfile = "%s.shard%d.npy" % (fname, sh.device.id)
+                        _save_synced(os.path.join(tmp, pfile), piece)
+                        manifest["vars"].setdefault(name, {
+                            "global_shape": list(arr.shape),
+                            "dtype": str(piece.dtype),
+                            "pieces": [],
+                        })["pieces"].append(
+                            {"file": pfile, "index": idx})
+                else:
+                    host = np.asarray(arr)            # D2H here
+                    _save_synced(os.path.join(tmp, fname + ".npy"), host)
+                    manifest["vars"][name] = {
+                        "global_shape": list(host.shape),
+                        "dtype": str(host.dtype),
+                        "pieces": [{"file": fname + ".npy",
+                                    "index": None}],
+                    }
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)                # file entries durable pre-rename
+            shutil.rmtree(final, ignore_errors=True)
+            os.rename(tmp, final)                     # atomic publish
+            _fsync_dir(self.root)                     # durable dir entry
+            self._gc()
+        except Exception as e:                        # noqa: BLE001
+            self._error = e
+
+    def _gc(self):
+        steps = self.all_steps()
+        for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
+            shutil.rmtree(os.path.join(self.root, "step_%d" % s),
+                          ignore_errors=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def wait(self):
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join()
+
+    def check_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint save failed") from err
+
+    @property
+    def in_flight(self):
+        with self._lock:
+            t = self._thread
+        return t is not None and t.is_alive()
+
+    # -- restore -----------------------------------------------------------
+    def all_steps(self):
+        steps = []
+        for d in os.listdir(self.root):
+            m = _STEP_RE.match(d)
+            if m and os.path.exists(
+                    os.path.join(self.root, d, "manifest.json")):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step=None):
+        """-> {name: np.ndarray} reassembled to global shape."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint under %s" % self.root)
+        d = os.path.join(self.root, "step_%d" % step)
+        with open(os.path.join(d, "manifest.json")) as f:
+            manifest = json.load(f)
+        out = {}
+        for name, spec in manifest["vars"].items():
+            pieces = spec["pieces"]
+            if len(pieces) == 1 and pieces[0]["index"] is None:
+                out[name] = np.load(os.path.join(d, pieces[0]["file"]))
+                continue
+            full = np.zeros(spec["global_shape"],
+                            np.dtype(spec["dtype"]))
+            for p in pieces:
+                arr = np.load(os.path.join(d, p["file"]))
+                sl = tuple(slice(a, b) for a, b in p["index"])
+                full[sl] = arr
+            out[name] = full
+        return out

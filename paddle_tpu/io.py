@@ -18,7 +18,62 @@ __all__ = [
     "save_vars", "save_params", "save_persistables",
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model",
+    "CheckpointManager", "save_checkpoint_async", "load_checkpoint",
 ]
+
+from paddle_tpu.checkpoint import CheckpointManager  # noqa: E402
+
+
+def save_checkpoint_async(manager, step, main_program=None, scope=None,
+                          blocking=False):
+    """Async save of a program's persistables through a CheckpointManager
+    (SURVEY §5: tensorstore-style background checkpoint; same var
+    selection as save_persistables). Returns immediately — the step loop
+    keeps training while the device->host transfer and writes happen on
+    the manager's background thread."""
+    main_program = main_program or default_main_program()
+    if scope is None:
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+    arrays = {}
+    for v in main_program.list_vars():
+        if not v.persistable:
+            continue
+        val = scope.get(v.name)
+        if val is not None:
+            arrays[v.name] = val
+    manager.save(step, arrays, blocking=blocking)
+    return sorted(arrays)
+
+
+def load_checkpoint(manager, main_program=None, scope=None, step=None,
+                    allow_partial=False):
+    """Restore a CheckpointManager checkpoint into the scope; returns the
+    restored step. A program persistable that is initialized in the scope
+    but absent from the checkpoint raises (a silently half-restored model
+    would train from an inconsistent state — the reference's load ops
+    likewise enforce per-var presence); pass ``allow_partial=True`` for
+    deliberate surgery like warm-starting a grown model."""
+    main_program = main_program or default_main_program()
+    if scope is None:
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+    step = manager.latest_step() if step is None else step
+    data = manager.restore(step)
+    names = {v.name for v in main_program.list_vars() if v.persistable}
+    missing = sorted(n for n in names
+                     if n not in data and scope.get(n) is not None)
+    if missing and not allow_partial:
+        raise KeyError(
+            "checkpoint step %s lacks persistable var(s) %s; pass "
+            "allow_partial=True to keep their current values"
+            % (step, missing))
+    for name, arr in data.items():
+        if name in names:
+            scope.set(name, arr)
+    return step
 
 
 def _is_persistable(var):

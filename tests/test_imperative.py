@@ -255,8 +255,14 @@ def test_layer_attribute_rebinding():
         assert holder.x is None and len(holder.sublayers()) == 0
         del holder.x
         assert not hasattr(holder, "x")
-        # assigning a Parameter onto a slot name must not destroy the
-        # registry itself
+        # assigning a Parameter onto a slot name is rejected outright —
+        # it could neither live in __dict__ (shadows the registry) nor
+        # in the registry (phantom entry named '_parameters')
         other = Layer()
-        other._parameters = param
+        try:
+            other._parameters = param
+            raise AssertionError("slot-name capture not rejected")
+        except TypeError:
+            pass
         assert isinstance(other.__dict__["_parameters"], dict)
+        assert len(other.parameters()) == 0
