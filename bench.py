@@ -40,7 +40,11 @@ def _throughput(run_step, batch, steps, warmup):
     return batch * steps / elapsed, float(np.asarray(val).reshape(-1)[0])
 
 
-def bench_mnist_mlp(batch=512, steps=50, warmup=10):
+def bench_mnist_mlp(batch=512, steps=50, warmup=10, reps=5):
+    """Median of ``reps`` timed windows: a 2-layer MLP step is ~pure
+    dispatch overhead on a tunneled chip, so a single window swings 2x+
+    with tunnel latency (VERDICT r3 Weak #7) — the median is the number
+    that means anything."""
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
@@ -60,8 +64,9 @@ def bench_mnist_mlp(batch=512, steps=50, warmup=10):
         step = lambda: exe.run(main, feed={"img": x, "label": y},
                                fetch_list=[h["loss"]],
                                return_numpy=False)[0]
-        ips, loss = _throughput(step, batch, steps, warmup)
-    return ips
+        vals = [_throughput(step, batch, steps, warmup)[0]
+                for _ in range(reps)]
+    return float(np.median(vals))
 
 
 def bench_resnet50(batch=None, steps=20, warmup=5):
@@ -138,16 +143,40 @@ def bench_bert_long(batch=4, seq_len=2048, steps=5, warmup=2):
                            seq_len=seq_len)
 
 
-def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=20):
+def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=10,
+                          reps=7):
     """Pallas flash fwd+bwd vs XLA-recompute backward at seq 2048 — the
     attention-training kernel win (TPU only; interpret mode would measure
-    the emulator)."""
+    the emulator).
+
+    Variance-robust protocol (VERDICT r3 Next #1). Two confounds sank the
+    previous protocols on the tunneled chip: a per-call overhead of
+    ~1-2.5s (dispatch + result readback over the tunnel) that dwarfs the
+    ~2-12ms kernels, and its session-to-session drift. Both cancel by
+    measuring the MARGINAL cost: each path runs as a lax.fori_loop of
+    fwd+bwd steps chained by a data dependency, timed at two loop counts
+    (``n_lo``/``n_hi``); per-step device time = (T_hi - T_lo)/Δn, with
+    the fixed overhead subtracting out. All four variants are timed
+    INTERLEAVED across ``reps`` rounds. The headline ``*_ms`` and
+    ``_speedup`` keys use diff-of-medians (median wall per loop count,
+    then difference — one outlier window cannot skew it); the per-rep
+    paired marginals feed the ``_min``/``_spread``/``_speedup_min``/
+    ``_speedup_max`` keys so the JSON carries its own error bars.
+    Calibration on this setup: a lone 4096^3 matmul dispatch reads
+    ~146ms/iter wall but ~3ms/iter marginal — single-shot timing
+    measures the tunnel, not the chip."""
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu.kernels.flash_attention import (_xla_attention,
-                                                    flash_attention)
+                                                    flash_attention,
+                                                    pick_block)
 
+    # Δn must make the signal (Δn x kernel time) dwarf the overhead
+    # jitter (~±0.5s) PER PATH: the ~2.5ms flash kernel needs ~4x the
+    # loop length of the ~12ms xla recompute for the same ~5s signal
+    n_lo = 8
+    n_hi = {"flash": n_lo + iters * 160, "xla": n_lo + iters * 40}
     if jax.default_backend() == "cpu":
         raise RuntimeError("flash bench requires the TPU backend")
     rng = np.random.RandomState(0)
@@ -158,33 +187,61 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=20):
     v = jax.device_put(jnp.asarray(
         rng.randn(batch, heads, seq, dim), jnp.bfloat16))
 
-    from paddle_tpu.kernels.flash_attention import pick_block
-
     bq = pick_block(seq)
-    flash_g = jax.jit(jax.grad(
+    flash_g = jax.grad(
         lambda a, b, c: jnp.sum(flash_attention(
             a, b, c, None, 0, True, None, 0.0, bq, bq,
             False).astype(jnp.float32)),
-        argnums=(0, 1, 2)))
-    xla_g = jax.jit(jax.grad(
+        argnums=(0, 1, 2))
+    xla_g = jax.grad(
         lambda a, b, c: jnp.sum(_xla_attention(
             a, b, c, True, dim ** -0.5).astype(jnp.float32)),
-        argnums=(0, 1, 2)))
+        argnums=(0, 1, 2))
 
-    def time_fn(fn):
-        jax.device_get(fn(q, k, v))  # compile + warm
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(iters):
-            out = fn(q, k, v)
-        jax.device_get(out)
-        return (time.perf_counter() - t0) / iters
+    from tools.marginal_timing import (chained_grad_loop,
+                                       run_marginal_protocol)
 
-    t_flash = time_fn(flash_g)
-    t_xla = time_fn(xla_g)
-    return {"flash_attn_bwd_ms_seq2048": round(t_flash * 1e3, 3),
-            "xla_recompute_bwd_ms_seq2048": round(t_xla * 1e3, 3),
-            "flash_attn_bwd_speedup": round(t_xla / t_flash, 3)}
+    variants = {
+        path: (chained_grad_loop(g, n_lo), n_lo,
+               chained_grad_loop(g, n_hi[path]), n_hi[path])
+        for path, g in (("flash", flash_g), ("xla", xla_g))}
+    measured = run_marginal_protocol(variants, (q, k, v), reps)
+    (med_flash, t_flash), (med_xla, t_xla) = (measured["flash"],
+                                              measured["xla"])
+    if med_flash <= 0 or med_xla <= 0:
+        # even the medians drowned in overhead jitter — no number from
+        # this session is trustworthy; better an errors entry than a
+        # garbage headline
+        raise RuntimeError(
+            "marginal timing non-positive (flash %.4fs, xla %.4fs): "
+            "tunnel overhead swamped the signal" % (med_flash, med_xla))
+    # a rep whose marginal went non-positive caught an overhead spike
+    # bigger than its whole signal; it carries no kernel information —
+    # exclude it from ALL per-rep statistics (ratios AND error bars)
+    t_flash_ok = [t for t in t_flash if t > 0]
+    t_xla_ok = [t for t in t_xla if t > 0]
+    ratios = sorted(x / f for f, x in zip(t_flash, t_xla)
+                    if f > 0 and x > 0)
+    ms = lambda s: round(float(s) * 1e3, 3)
+    out = {
+        "flash_attn_bwd_ms_seq2048": ms(med_flash),
+        "xla_recompute_bwd_ms_seq2048": ms(med_xla),
+        "flash_attn_bwd_speedup": round(med_xla / med_flash, 3),
+        "flash_attn_bwd_reps": reps,
+        "flash_attn_bwd_reps_clean": len(ratios),
+    }
+    if t_flash_ok:
+        out["flash_attn_bwd_ms_min"] = ms(min(t_flash_ok))
+        out["flash_attn_bwd_ms_spread"] = ms(
+            max(t_flash_ok) - min(t_flash_ok))
+    if t_xla_ok:
+        out["xla_recompute_bwd_ms_min"] = ms(min(t_xla_ok))
+        out["xla_recompute_bwd_ms_spread"] = ms(
+            max(t_xla_ok) - min(t_xla_ok))
+    if ratios:
+        out["flash_attn_bwd_speedup_min"] = round(ratios[0], 3)
+        out["flash_attn_bwd_speedup_max"] = round(ratios[-1], 3)
+    return out
 
 
 def main():

@@ -412,12 +412,37 @@ def _check_tileable(q, k, block_q, block_k):
             "for automatic XLA fallback on odd shapes" % (Tq, Tk, bq, bk))
 
 
-def pick_block(t):
-    """Measured block-size tier for the Pallas kernels: 256-wide blocks
-    run ~5% faster than 128 at seq 2048 on v5e (113.7 vs 119.2 ms
-    fwd+bwd; 512 ties) whenever the sequence tiles. Shared by the
+@functools.lru_cache(maxsize=None)
+def _block_table():
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__),
+                        "flash_block_table.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):  # pragma: no cover
+        return {}
+
+
+def pick_block(t, dtype=None):
+    """Block-size choice for the Pallas kernels, driven by the committed
+    sweep table (flash_block_table.json, produced on real hardware by
+    tools/flash_block_sweep.py with an interleaved median-of-reps
+    protocol — the jit kernel-benchmark discipline of the reference's
+    operators/jit/README.en.md). Lookup is by (dtype, nearest swept seq);
+    the winning block is clamped to one that tiles ``t``. Heuristic
+    fallback (256 when it tiles) if the table is absent. Shared by the
     fused_attention dispatch and bench.py so the benchmark measures the
     production configuration."""
+    table = _block_table().get(
+        jnp.dtype(dtype).name if dtype is not None else "bfloat16")
+    if table:
+        swept = min(table, key=lambda s: abs(int(s) - t))
+        for blk in (int(table[swept]), 256, 128):
+            if t % blk == 0 and t >= blk:
+                return blk
     return 256 if t % 256 == 0 and t >= 256 else 128
 
 
@@ -521,8 +546,9 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
         and Tk >= _flash_min_seq())
     if use_pallas:
         return flash_attention(q, k, v, seq_lens, seed, causal, scale,
-                               dropout_rate, block_q=pick_block(Tq),
-                               block_k=pick_block(Tk),
+                               dropout_rate,
+                               block_q=pick_block(Tq, q.dtype),
+                               block_k=pick_block(Tk, q.dtype),
                                interpret=not _on_tpu())
     key = jax.random.PRNGKey(seed) if dropout_rate > 0.0 else None
     return _xla_attention(q, k, v, causal, scale, seq_lens, dropout_rate,

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Sweep flash-attention block sizes on the real chip and emit the
+committed autotune table consumed by ``pick_block`` (VERDICT r3 Next #9:
+replace the one-off hand tune with a table from a reproducible sweep;
+the discipline of the reference's jit kernel benchmarks,
+benchmark/paddle/fluid/operators/jit/README.en.md).
+
+Protocol: the same MARGINAL-cost measurement as ``bench.py``'s flash
+bench — on the tunneled chip a single drained window carries ~1-2.5s of
+session-variable dispatch/readback overhead that dwarfs the ms-scale
+kernels, so each (dtype, seq, block) config runs as one jitted
+``lax.fori_loop`` of chained fwd+bwd steps at TWO loop counts; per-step
+device time = (T_hi - T_lo)/Δn (overhead subtracts out), diff-of-medians
+over ``reps`` interleaved rounds. Δn is sized from a FLOP model so every
+config's signal is ~3s. Configs that fail to compile (VMEM OOM at wide
+blocks x long f32 seqs) are skipped; the table is dumped incrementally
+after every (dtype, seq) row so a late failure cannot lose the sweep.
+
+Writes paddle_tpu/kernels/flash_block_table.json:
+    {"bfloat16": {"256": best_block, ...}, "float32": {...}}
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+OUT = os.path.abspath(os.path.join(
+    os.path.dirname(__file__), os.pardir, "paddle_tpu", "kernels",
+    "flash_block_table.json"))
+
+
+from tools.marginal_timing import (chained_grad_loop,  # noqa: E402
+                                   run_marginal_protocol)
+
+
+def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=(128, 256, 512),
+          dtypes=("bfloat16", "float32"), batch=4, heads=16, dim=64,
+          reps=3, target_signal_s=3.0):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    assert jax.default_backend() != "cpu", "sweep needs the TPU backend"
+    table = {}
+    for dtype in dtypes:
+        table[dtype] = {}
+        for seq in seqs:
+            rng = np.random.RandomState(0)
+            # long f32 runs blow HBM sooner; shrink batch at 4096
+            b = batch if seq < 4096 else max(1, batch // 2)
+            q, k, v = (jax.device_put(jnp.asarray(
+                rng.randn(b, heads, seq, dim), dtype)) for _ in range(3))
+            # fwd+bwd ~ 3.5 x 4*B*H*T^2*D FLOPs; assume >=20 TFLOP/s so
+            # Δn errs toward a LONGER (higher-signal) window
+            est_s = 3.5 * 4 * b * heads * seq * seq * dim / 20e12
+            dn = int(min(4096, max(64, target_signal_s / est_s)))
+            n_lo, n_hi = 4, 4 + dn
+            variants = {}
+            for blk in blocks:
+                if seq % blk:
+                    continue
+                g = jax.grad(
+                    lambda a, c, d, _blk=blk: jnp.sum(flash_attention(
+                        a, c, d, None, 0, True, None, 0.0, _blk, _blk,
+                        False).astype(jnp.float32)),
+                    argnums=(0, 1, 2))
+                try:
+                    fn_lo = chained_grad_loop(g, n_lo)
+                    jax.device_get(fn_lo(q, k, v))   # compile check
+                    fn_hi = chained_grad_loop(g, n_hi)
+                    jax.device_get(fn_hi(q, k, v))
+                except Exception as e:              # noqa: BLE001
+                    print("dtype=%s seq=%d block %d skipped: %s"
+                          % (dtype, seq, blk, str(e)[:100]), flush=True)
+                    continue
+                variants[blk] = (fn_lo, n_lo, fn_hi, n_hi)
+            if not variants:
+                print("dtype=%s seq=%d: no block compiled, row omitted"
+                      % (dtype, seq), flush=True)
+                continue
+            measured = run_marginal_protocol(variants, (q, k, v), reps)
+            # a non-positive marginal is an overhead spike, not a kernel
+            # time — it must never be crowned the winner
+            med = {blk: m for blk, (m, _) in measured.items() if m > 0}
+            if not med:
+                print("dtype=%s seq=%d: all marginals drowned in "
+                      "overhead noise, row omitted" % (dtype, seq),
+                      flush=True)
+                continue
+            best = min(med, key=med.get)
+            table[dtype][str(seq)] = best
+            print("dtype=%s seq=%d dn=%d -> block %d   %s" % (
+                dtype, seq, dn, best,
+                " ".join("%d:%.3fms" % (b_, m * 1e3)
+                         for b_, m in sorted(med.items()))), flush=True)
+            with open(OUT, "w") as f:                # incremental dump
+                json.dump(table, f, indent=1, sort_keys=True)
+    return table
+
+
+if __name__ == "__main__":
+    sweep()
+    print("wrote", OUT)

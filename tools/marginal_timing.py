@@ -1,0 +1,69 @@
+"""Shared core of the marginal-cost timing protocol (used by bench.py's
+flash bench and tools/flash_block_sweep.py — one implementation so the
+sweep table and the benchmark that cites it measure the same thing).
+
+On the tunneled chip a single dispatch carries ~1-2.5s of
+session-variable overhead that dwarfs ms-scale kernels; the protocol
+times a jitted ``lax.fori_loop`` of data-dependency-chained steps at two
+loop counts and reports (T_hi - T_lo)/Δn, cancelling the fixed overhead.
+"""
+
+
+def run_marginal_protocol(variants, args, reps):
+    """The shared two-loop-count timing driver.
+
+    ``variants``: {key: (fn_lo, n_lo, fn_hi, n_hi)} — jitted chained
+    loops for the same computation at two loop counts. Every window is
+    compiled+warmed once, then all windows are timed INTERLEAVED for
+    ``reps`` rounds (so overhead drift hits every variant equally).
+
+    Returns {key: (marginal_seconds, per_rep_marginals)} where the
+    headline marginal is diff-of-medians — median wall per loop count,
+    then difference, so one outlier window cannot skew it — and
+    ``per_rep_marginals`` are the paired per-round differences for error
+    bars. Callers must treat non-positive values as overhead noise, not
+    kernel signal."""
+    import time
+
+    import jax
+    import numpy as np
+
+    wall = {}
+    for key, (fn_lo, _, fn_hi, _) in variants.items():
+        jax.device_get(fn_lo(*args))        # compile + warm
+        jax.device_get(fn_hi(*args))
+        wall[key] = ([], [])
+    for _ in range(reps):
+        for key, (fn_lo, _, fn_hi, _) in variants.items():
+            for which, fn in ((0, fn_lo), (1, fn_hi)):
+                t0 = time.perf_counter()
+                jax.device_get(fn(*args))
+                wall[key][which].append(time.perf_counter() - t0)
+    out = {}
+    for key, (_, n_lo, _, n_hi) in variants.items():
+        lo, hi = wall[key]
+        dn = n_hi - n_lo
+        headline = (float(np.median(hi)) - float(np.median(lo))) / dn
+        per_rep = [(h - l) / dn for l, h in zip(lo, hi)]
+        out[key] = (headline, per_rep)
+    return out
+
+
+def chained_grad_loop(grad_fn, n):
+    """One jitted call running ``n`` fwd+bwd steps of ``grad_fn(q, k, v)
+    -> (dq, dk, dv)`` chained by a data dependency: the 1e-30*dq term
+    makes step i+1 depend on step i's output so XLA cannot collapse the
+    loop, while perturbing q by less than one bf16 ulp."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(q, k, v):
+        def body(_, carry):
+            dq, dk, dv = grad_fn(
+                q + (1e-30 * carry[0]).astype(q.dtype), k, v)
+            return dq, dk, dv
+        init = (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
+        return lax.fori_loop(0, n, body, init)
+    return run
