@@ -215,13 +215,16 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=10,
         raise RuntimeError(
             "marginal timing non-positive (flash %.4fs, xla %.4fs): "
             "tunnel overhead swamped the signal" % (med_flash, med_xla))
-    # a rep whose marginal went non-positive caught an overhead spike
-    # bigger than its whole signal; it carries no kernel information —
-    # exclude it from ALL per-rep statistics (ratios AND error bars)
-    t_flash_ok = [t for t in t_flash if t > 0]
-    t_xla_ok = [t for t in t_xla if t > 0]
+    # a rep whose marginal is non-positive OR far below the headline
+    # median caught an overhead swing bigger than its signal; it carries
+    # no kernel information — exclude it from ALL per-rep statistics
+    # (ratios AND error bars), else an epsilon-positive rep publishes an
+    # absurd speedup_max
+    lo_f, lo_x = 0.25 * med_flash, 0.25 * med_xla
+    t_flash_ok = [t for t in t_flash if t > lo_f]
+    t_xla_ok = [t for t in t_xla if t > lo_x]
     ratios = sorted(x / f for f, x in zip(t_flash, t_xla)
-                    if f > 0 and x > 0)
+                    if f > lo_f and x > lo_x)
     ms = lambda s: round(float(s) * 1e3, 3)
     out = {
         "flash_attn_bwd_ms_seq2048": ms(med_flash),

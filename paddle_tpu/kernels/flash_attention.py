@@ -412,18 +412,26 @@ def _check_tileable(q, k, block_q, block_k):
             "for automatic XLA fallback on odd shapes" % (Tq, Tk, bq, bk))
 
 
-@functools.lru_cache(maxsize=None)
-def _block_table():
-    import json
-    import os
+_BLOCK_TABLE_CACHE = None
 
-    path = os.path.join(os.path.dirname(__file__),
-                        "flash_block_table.json")
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):  # pragma: no cover
-        return {}
+
+def _block_table():
+    """Sweep table, cached only on a SUCCESSFUL load — a transient read
+    failure (e.g. the file mid-rewrite by the sweep's incremental dump)
+    must not pin the heuristic fallback for the process lifetime."""
+    global _BLOCK_TABLE_CACHE
+    if _BLOCK_TABLE_CACHE is None:
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(__file__),
+                            "flash_block_table.json")
+        try:
+            with open(path) as f:
+                _BLOCK_TABLE_CACHE = json.load(f)
+        except (OSError, ValueError):  # pragma: no cover
+            return {}
+    return _BLOCK_TABLE_CACHE
 
 
 def pick_block(t, dtype=None):

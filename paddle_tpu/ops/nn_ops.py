@@ -6,6 +6,9 @@ lookup_table_op.cc. Lowerings emit lax convolutions (MXU) and keep the
 public NCHW layout contract; XLA's TPU layout assignment picks the physical
 layout, so no data_layout_transform pass is needed (reference:
 paddle/fluid/framework/data_layout_transform.cc becomes a no-op concern).
+Verified on hardware in round 4: an end-to-end NHWC ResNet-50 formulation
+times within +0.3% of this NCHW lowering (tools/resnet_probe.py
+full-nhwc, MFU_r04.md) — the logical layout is immaterial under XLA:TPU.
 """
 
 import numpy as np

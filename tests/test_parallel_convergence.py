@@ -16,12 +16,14 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu import models
 
 
-def _run_trajectory(build, batches, compiled_fn=None):
-    """Train from a FIXED parameter init; returns (losses, final_params).
+def _run_trajectory(build, batches, compiled_fn=None, init=None):
+    """Train from a FIXED parameter init; returns (losses, final_params,
+    init_params).
 
-    build() must construct a fresh program each call; parameters are
-    copied by position from the first run so both runs start identically
-    (unique_name gives each build fresh var names)."""
+    build() must construct a fresh program each call. Pass the first
+    run's returned ``init`` into the second so both start identically —
+    parameters are copied by position (unique_name gives each build
+    fresh var names)."""
     main, startup, h = build()
     exe = fluid.Executor()
     scope = fluid.Scope()
@@ -29,12 +31,11 @@ def _run_trajectory(build, batches, compiled_fn=None):
     losses = []
     with fluid.scope_guard(scope):
         exe.run(startup)
-        if _run_trajectory.init is None:
-            _run_trajectory.init = [
-                np.asarray(scope.get(p.name))
-                for p in main.all_parameters()]
+        if init is None:
+            init = [np.asarray(scope.get(p.name))
+                    for p in main.all_parameters()]
         else:
-            for p, v in zip(main.all_parameters(), _run_trajectory.init):
+            for p, v in zip(main.all_parameters(), init):
                 scope.set(p.name, v)
         for b in batches:
             (l,) = exe.run(prog, feed=b, fetch_list=[h["loss"]])
@@ -43,7 +44,7 @@ def _run_trajectory(build, batches, compiled_fn=None):
         # suffixes sort differently ("..._10" < "..._2")
         params = [(p.name, np.asarray(scope.get(p.name)))
                   for p in main.all_parameters()]
-    return np.asarray(losses), params
+    return np.asarray(losses), params, init
 
 
 def _dp(main, h):
@@ -61,11 +62,10 @@ def test_mnist_mlp_50step_convergence_equivalence():
         y = np.argmax(x @ W, 1).astype(np.int64).reshape(-1, 1)
         batches.append({"img": x, "label": y})
 
-    _run_trajectory.init = None
-    single, _ = _run_trajectory(
+    single, _, init = _run_trajectory(
         lambda: models.mnist.get_model(lr=0.1), batches)
-    spmd, _ = _run_trajectory(
-        lambda: models.mnist.get_model(lr=0.1), batches, _dp)
+    spmd, _, _ = _run_trajectory(
+        lambda: models.mnist.get_model(lr=0.1), batches, _dp, init)
 
     # trajectory equivalence: every step stays within float-accumulation
     # tolerance of the single-device run (8-way sharded reductions
@@ -89,9 +89,8 @@ def test_resnet_bn_50step_convergence_equivalence():
 
     build = lambda: models.resnet.get_model(dataset="cifar10", depth=8,
                                             lr=0.05)
-    _run_trajectory.init = None
-    single, p_single = _run_trajectory(build, batches)
-    spmd, p_spmd = _run_trajectory(build, batches, _dp)
+    single, p_single, init = _run_trajectory(build, batches)
+    spmd, p_spmd, _ = _run_trajectory(build, batches, _dp, init)
 
     # BN's rsqrt + residual depth amplify rounding, so the per-step band
     # is wider than the MLP's; fork-detection is the point — a per-shard
