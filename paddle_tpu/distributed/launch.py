@@ -15,10 +15,12 @@ import sys
 
 
 def launch_processes(script_args, nproc=1, started_port=6170,
-                     node_ip="127.0.0.1", env_extra=None):
+                     node_ip="127.0.0.1", env_extra=None,
+                     capture_output=False):
     endpoints = [
         "%s:%d" % (node_ip, started_port + i) for i in range(nproc)
     ]
+    pipe = subprocess.PIPE if capture_output else None
     procs = []
     for rank in range(nproc):
         env = dict(os.environ)
@@ -30,7 +32,8 @@ def launch_processes(script_args, nproc=1, started_port=6170,
         # rank 0 hosts the PJRT coordinator (the gen_nccl_id analog)
         env["COORDINATOR_ADDRESS"] = endpoints[0]
         cmd = [sys.executable] + list(script_args)
-        procs.append(subprocess.Popen(cmd, env=env))
+        procs.append(subprocess.Popen(cmd, env=env, stdout=pipe,
+                                      stderr=pipe))
     return procs
 
 

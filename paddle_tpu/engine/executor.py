@@ -18,7 +18,8 @@ from paddle_tpu.engine.lowering import BlockProgram, lower_block
 
 
 class CompiledBlock:
-    def __init__(self, block_program, jitted, mutated_names, readonly_names):
+    def __init__(self, block_program, jitted, mutated_names, readonly_names,
+                 in_shardings=None):
         self.block_program = block_program
         self.jitted = jitted
         # state vars both read and re-emitted -> donated to XLA (functional
@@ -26,6 +27,10 @@ class CompiledBlock:
         self.mutated_names = mutated_names
         # state vars only read (e.g. params in a test program) -> not donated
         self.readonly_names = readonly_names
+        # (feed, mutated, readonly) NamedShardings under SPMD — the
+        # multi-host run path needs them to build global jax.Arrays from
+        # host values (None when compiled without a mesh)
+        self.in_shardings = in_shardings
 
 
 class Engine:
@@ -119,6 +124,35 @@ class Engine:
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
+
+        if mesh is not None and jax.process_count() > 1:
+            # Multi-host SPMD: the jit's in_shardings span devices of
+            # OTHER processes, so every argument must arrive as a GLOBAL
+            # jax.Array. Host values carry the same global value on
+            # every process (the gen_nccl_id-era data contract), so each
+            # process materializes its local shards of the declared
+            # sharding via make_array_from_callback; a jax.Array still
+            # committed to this process's local devices (params right
+            # after the un-meshed startup run) round-trips through the
+            # host once. After the first step the state comes back
+            # globally sharded and passes through untouched.
+            mesh_devs = frozenset(mesh.devices.flat)
+
+            def _globalize(v, sharding):
+                if (isinstance(v, jax.Array)
+                        and frozenset(v.sharding.device_set) == mesh_devs):
+                    return v
+                host = np.asarray(v)
+                return jax.make_array_from_callback(
+                    host.shape, sharding, lambda idx: host[idx])
+
+            feed_sh, mut_sh, ro_sh = compiled.in_shardings
+            feed_values = [_globalize(v, s)
+                           for v, s in zip(feed_values, feed_sh)]
+            mutated = [_globalize(v, s)
+                       for v, s in zip(mutated, mut_sh)]
+            readonly = [_globalize(v, s)
+                        for v, s in zip(readonly, ro_sh)]
 
         self._run_counter += 1
         # The PRNG key is derived INSIDE the jitted function from two scalar
@@ -232,7 +266,10 @@ class Engine:
                 [state_sharding(n) for n in bp.state_out_names],
             )
         jitted = jax.jit(wrapped, donate_argnums=donate, **jit_kwargs)
-        return CompiledBlock(bp, jitted, mutated, readonly)
+        in_sh = (tuple(jit_kwargs["in_shardings"][:3])
+                 if "in_shardings" in jit_kwargs else None)
+        return CompiledBlock(bp, jitted, mutated, readonly,
+                             in_shardings=in_sh)
 
 
 def _check_finite(named_values):

@@ -38,17 +38,43 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     ignore_index = attrs.get("ignore_index", -100)
     # Losses always compute in fp32: low-precision logits (AMP keeps
     # activations bf16 end-to-end) lose too much in the log-sum-exp.
-    logits = fp32_accum(logits)
-    log_sm = jax.nn.log_softmax(logits, axis=-1)
-    softmax_out = jnp.exp(log_sm)
+    # The hard-label loss is computed as lse(logits) - logits[label]
+    # WITHOUT forming log_softmax: -log_softmax[y] materializes an fp32
+    # tensor of the full logits width just to gather one column — for
+    # BERT's [B*T, 30522] MLM head that is a ~1 GB intermediate per
+    # step; the lse form keeps everything fused into the reductions
+    # (round-4 trace: the head's fwd went from ~6ms of layout-change
+    # copies + full-width math to reductions only).
     if soft:
+        logits32 = fp32_accum(logits)
+        log_sm = jax.nn.log_softmax(logits32, axis=-1)
         loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
+        softmax_out = jnp.exp(log_sm)
     else:
+        # No gather, no upfront fp32 copy: a gather consumer forces its
+        # operand to MATERIALIZE (in the gather's preferred layout — a
+        # ~500MB layout-change copy of the BERT MLM logits in the
+        # round-4 trace), so the label column is picked with a fused
+        # one-hot reduction instead, and the per-element f32 converts
+        # fuse into the max/sum reductions.
         idx = _squeeze_label(label)
-        picked = jnp.take_along_axis(log_sm, idx[..., None].astype(jnp.int32), axis=-1)
-        loss = -picked
-        if ignore_index >= 0:
-            loss = jnp.where(idx[..., None] == ignore_index, 0.0, loss)
+        iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                        logits.ndim - 1)
+        hit = iota == idx[..., None].astype(jnp.int32)
+        picked = jnp.sum(jnp.where(hit, fp32_accum(logits), 0.0),
+                         axis=-1, keepdims=True)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        z = fp32_accum(logits) - fp32_accum(m)
+        s = jnp.sum(jnp.exp(z), axis=-1, keepdims=True)
+        lse = fp32_accum(m) + jnp.log(s)
+        loss = lse - picked
+        # a label EQUAL to ignore_index contributes no loss, whatever
+        # its sign (reference: softmax_with_cross_entropy_op.h treats
+        # the default -100 as ignored too)
+        loss = jnp.where(idx[..., None] == ignore_index, 0.0, loss)
+        # dead unless a consumer actually reads the Softmax output
+        # (return_softmax=True) — XLA drops it otherwise
+        softmax_out = jnp.exp(z) / s
     return {"Softmax": [softmax_out], "Loss": [loss]}
 
 
@@ -328,7 +354,12 @@ def softmax_with_cross_entropy_grad(ctx, ins, attrs):
     g_sm = g_sm[0] if g_sm else None
     soft = attrs.get("soft_label", False)
     ignore_index = attrs.get("ignore_index", -100)
-    sm = jax.nn.softmax(fp32_accum(logits), axis=-1)
+    # softmax recomputed from raw logits with the f32 converts INSIDE
+    # the fusions (an upfront fp32 copy materializes the full logits
+    # width — ~1 GB for the BERT MLM head; see the forward op's note)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    z = fp32_accum(logits) - fp32_accum(m)
+    sm = jnp.exp(z) / jnp.sum(jnp.exp(z), axis=-1, keepdims=True)
     grad = jnp.zeros_like(sm)
     if g_loss is not None:
         if soft:
@@ -338,9 +369,10 @@ def softmax_with_cross_entropy_grad(ctx, ins, attrs):
             onehot = jax.nn.one_hot(idx, logits.shape[-1],
                                     dtype=sm.dtype)
             grad = (sm - onehot) * g_loss
-            if ignore_index >= 0:
-                grad = jnp.where((idx == ignore_index)[..., None], 0.0,
-                                 grad)
+            # ignored labels (== ignore_index, any sign) get zero grad,
+            # matching the forward's zeroed loss
+            grad = jnp.where((idx == ignore_index)[..., None], 0.0,
+                             grad)
     if g_sm is not None:
         # cotangent through the Softmax output (return_softmax=True
         # consumers, e.g. distillation): softmax vjp

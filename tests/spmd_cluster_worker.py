@@ -1,0 +1,57 @@
+"""Worker for the 2-process SPMD cluster test (VERDICT r3 Next #4).
+
+Launched by paddle_tpu.distributed.launch (which sets the
+PADDLE_TRAINER_* env), each process self-provisions 4 virtual CPU
+devices, joins the jax.distributed coordinator (the gen_nccl_id-analog
+bootstrap, parallel/env.py), and trains the graft-entry dp×tp BERT step
+over the GLOBAL 8-device mesh for a few steps. Prints one JSON line of
+losses; the parent asserts cross-rank and vs-single-process parity.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4"
+                           ).strip()
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from paddle_tpu.parallel import env as penv
+
+    info = penv.init_distributed()
+    assert jax.process_count() == info["world_size"] == 2, (
+        jax.process_count(), info)
+    assert jax.device_count() == 8, jax.device_count()
+    assert len(jax.local_devices()) == 4
+
+    import __graft_entry__ as graft
+    import paddle_tpu.fluid as fluid
+
+    compiled, main_prog, startup, h, batch = graft.build_bert_spmd(8)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(4):
+            (loss,) = exe.run(compiled, feed=batch,
+                              fetch_list=[h["loss"]])
+            losses.append(float(np.asarray(loss).reshape(-1)[0]))
+    print("CLUSTER_RESULT " + json.dumps(
+        {"rank": info["rank"], "losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

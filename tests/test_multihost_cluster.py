@@ -1,0 +1,88 @@
+"""Real multi-process SPMD cluster test (VERDICT r3 Next #4; reference:
+tests/unittests/test_dist_base.py:438 _run_cluster_nccl2 — the reference
+proves its collective mode with real multi-process clusters, bootstrap
+gen_nccl_id_op.cc; here the bootstrap is jax.distributed via
+parallel/env.py and the launcher is distributed/launch.py).
+
+Two subprocesses x 4 virtual CPU devices each join a coordinator, build
+the GLOBAL 8-device dp×tp mesh, and train the graft-entry BERT step;
+losses must agree across ranks and with the same model trained in ONE
+process on its own 8-device mesh."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_losses():
+    import paddle_tpu.fluid as fluid
+    import __graft_entry__ as graft
+
+    compiled, main_prog, startup, h, batch = graft.build_bert_spmd(8)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(4):
+            (loss,) = exe.run(compiled, feed=batch,
+                              fetch_list=[h["loss"]])
+            losses.append(float(np.asarray(loss).reshape(-1)[0]))
+    return losses
+
+
+def test_two_process_cluster_matches_single_process():
+    from paddle_tpu.distributed.launch import launch_processes
+
+    worker = os.path.join(REPO, "tests", "spmd_cluster_worker.py")
+    # the launcher's endpoint list doubles as the coordinator address
+    # (rank 0's endpoint), exactly as init_distributed consumes it
+    port = _free_port()
+    env_extra = {}
+    for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
+        env_extra[var] = ""   # the worker sets its own platform config
+    procs = launch_processes([worker], nproc=2, started_port=port,
+                             env_extra=env_extra, capture_output=True)
+    outs, errs = [], []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+        errs.append(err)
+    assert all(p.returncode == 0 for p in procs), (
+        [e.decode()[-2000:] for e in errs])
+
+    results = {}
+    for out in outs:
+        for line in out.decode().splitlines():
+            if line.startswith("CLUSTER_RESULT "):
+                r = json.loads(line[len("CLUSTER_RESULT "):])
+                results[r["rank"]] = r["losses"]
+    assert sorted(results) == [0, 1], (results, outs, errs)
+    # both ranks computed the SAME global step
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+
+    single = _single_process_losses()
+    # same math as one process over 8 local devices: parity within
+    # float-reassociation tolerance (cross-host collectives reassociate)
+    np.testing.assert_allclose(results[0], single, rtol=1e-4, atol=1e-5)
+    # and it genuinely trains
+    assert results[0][-1] < results[0][0]

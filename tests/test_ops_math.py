@@ -235,3 +235,35 @@ class TestTensorOps(OpTest):
             attrs={"padding_idx": -1},
         )
 
+
+
+def test_softmax_ce_ignore_index_default():
+    """Labels equal to ignore_index contribute zero loss AND zero
+    gradient — including the default -100 (round-4 review finding: the
+    old guard skipped masking for negative ignore_index values)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        logits = fluid.layers.scale(x, scale=1.0)
+        loss = fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                       label=y)
+        total = fluid.layers.mean(loss)
+        fluid.backward.append_backward(total)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.random.RandomState(0).randn(4, 5).astype(np.float32) * 5
+    yv = np.array([[1], [-100], [3], [-100]], np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        lv, gv = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[loss, logits.name + "@GRAD"])
+    lv, gv = np.asarray(lv), np.asarray(gv)
+    assert lv[1] == 0.0 and lv[3] == 0.0, lv
+    assert np.all(gv[1] == 0.0) and np.all(gv[3] == 0.0), gv
+    # non-ignored rows match the reference formula
+    ref = -np.log(np.exp(xv[0]) / np.exp(xv[0]).sum())[1]
+    np.testing.assert_allclose(lv[0], ref, rtol=1e-5)

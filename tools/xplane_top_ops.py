@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Aggregate device-time by op from a jax.profiler xplane trace — the
+trace-reading half of the profiler story (SURVEY §5), used in round 4 to
+find where the BERT engine step spends its time vs the probe.
+
+Usage: PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+           python tools/xplane_top_ops.py <trace_dir> [top_n] [group]
+``group``: 'op' (default, per fused-computation name) or 'kind'
+(collapse to the HLO opcode-ish prefix, e.g. fusion/copy/convolution).
+"""
+import glob
+import re
+import sys
+from collections import defaultdict
+
+
+def top_ops(trace_dir, top_n=25, group="op"):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = glob.glob("%s/**/*.xplane.pb" % trace_dir, recursive=True)
+    assert files, "no xplane.pb under %s" % trace_dir
+    per = defaultdict(float)
+    total = 0.0
+    # aggregate over every host's trace file and every device plane
+    # (multi-core chips emit one plane per core)
+    for f in files:
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(f, "rb").read())
+        planes = [p for p in xs.planes if "/device:" in p.name
+                  and sum(len(l.events) for l in p.lines)]
+        for plane in planes:
+            meta = {m.id: m.name for m in plane.event_metadata.values()}
+            for line in plane.lines:
+                if line.name != "XLA Ops":
+                    continue
+                for e in line.events:
+                    name = meta.get(e.metadata_id, "?")
+                    if group == "kind":
+                        name = re.split(r"[.\d]", name, 1)[0]
+                    per[name] += e.duration_ps / 1e9
+                    total += e.duration_ps / 1e9
+    rows = sorted(per.items(), key=lambda kv: -kv[1])[:top_n]
+    return rows, total
+
+
+if __name__ == "__main__":
+    d = sys.argv[1]
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    g = sys.argv[3] if len(sys.argv) > 3 else "op"
+    rows, total = top_ops(d, n, g)
+    print("total XLA-op device ms: %.2f" % total)
+    for name, ms in rows:
+        print("%8.2f ms  %5.1f%%  %s" % (ms, ms / total * 100, name[:90]))
