@@ -54,7 +54,27 @@ class PaddleTensor:
 
 class AnalysisPredictor:
     def __init__(self, config):
+        import jax
+
+        from paddle_tpu.aot import AotPredictor, has_aot_artifact
+
         self.config = config
+        self._aot = None
+        if has_aot_artifact(config.model_dir):
+            # serialized StableHLO artifact present: execute it directly
+            # — no Program rebuild, no op-registry re-lowering
+            # (reference: analysis_predictor.cc:391's frozen-load path).
+            # The artifact is platform-specialized; if it was exported
+            # for a different backend (or the user disabled the
+            # accelerator), fall back to the native files beside it.
+            aot = AotPredictor(config.model_dir)
+            backend = "cpu" if not config._use_accelerator \
+                else jax.default_backend()
+            if aot.runs_on(backend):
+                self._aot = aot
+                self._feed_names = aot.feed_names
+                self._fetch_names = aot.fetch_names
+                return
         place = TPUPlace() if config._use_accelerator else CPUPlace()
         self._exe = Executor(place)
         self._scope = Scope()
@@ -83,9 +103,12 @@ class AnalysisPredictor:
             feed = {}
             for name, t in zip(self._feed_names, inputs):
                 feed[t.name or name] = t.data
-        with fluid.scope_guard(self._scope):
-            outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_names)
+        if self._aot is not None:
+            outs = self._aot.run(feed)
+        else:
+            with fluid.scope_guard(self._scope):
+                outs = self._exe.run(self._program, feed=feed,
+                                     fetch_list=self._fetch_names)
         return [PaddleTensor(o, n) for o, n in zip(outs, self._fetch_names)]
 
 

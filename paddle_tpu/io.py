@@ -191,11 +191,18 @@ def _prune_for_inference(program, feed_names, fetch_names):
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
-                         export_format="native"):
+                         export_format="native", example_feeds=None):
     """``export_format="reference"`` writes the reference's on-disk format
     instead — binary framework.proto ``__model__`` + per-var tensor
     streams — so reference tooling can load repo models (reference:
-    framework.proto:24-188, lod_tensor.cc SerializeToStream)."""
+    framework.proto:24-188, lod_tensor.cc SerializeToStream).
+
+    ``export_format="aot"`` ADDITIONALLY writes a serialized StableHLO
+    artifact (jax.export, params baked in) next to the native format;
+    ``example_feeds`` {name: array} must fix every feed's shape/dtype.
+    ``AotPredictor``/``AnalysisPredictor`` then execute it without
+    re-lowering through the op registry (VERDICT r3 Next #8; reference:
+    analysis_predictor.cc:391 load-and-run without the front-end)."""
     if export_format == "reference":
         from paddle_tpu import compat
 
@@ -219,6 +226,19 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         json.dump(meta, f)
     save_persistables(executor, dirname, main_program,
                       filename=params_filename)
+    if export_format == "aot":
+        from paddle_tpu.aot import export_aot
+        from paddle_tpu.executor import global_scope
+
+        export_aot(dirname, feeded_var_names, fetch_names, pruned,
+                   global_scope(), example_feeds or {})
+    else:
+        # a re-save in native format must invalidate any stale AOT
+        # artifact, or the predictor would keep serving the OLD weights
+        # baked into it
+        from paddle_tpu.aot import remove_aot_artifact
+
+        remove_aot_artifact(dirname)
     return fetch_names
 
 
