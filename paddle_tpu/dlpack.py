@@ -1,0 +1,35 @@
+"""DLPack interop (reference: paddle/fluid/framework/dlpack_tensor.cc —
+LoDTensor <-> DLPack conversion for zero-copy exchange with other
+frameworks; here the tensors are jax arrays, which speak the standard
+``__dlpack__`` protocol natively)."""
+
+import numpy as np
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(value):
+    """A DLPack capsule for a framework tensor. CPU-resident jax arrays
+    export zero-copy; TPU-resident arrays (XLA's DLPack export covers
+    only CPU/GPU buffers) and plain host values are staged through one
+    host copy. Consumers: ``torch.utils.dlpack.from_dlpack``,
+    ``np.from_dlpack``, etc."""
+    import jax
+
+    if isinstance(value, jax.Array):
+        try:
+            return value.__dlpack__()
+        except (RuntimeError, TypeError, ValueError):
+            pass  # device buffer not DLPack-exportable: copy to host
+    # np.array(copy=True): device_get views are readonly and numpy
+    # refuses to export readonly buffers over DLPack
+    return np.array(value, copy=True).__dlpack__()
+
+
+def from_dlpack(external):
+    """A jax array sharing memory with ``external`` where the platform
+    allows it. Accepts any object implementing ``__dlpack__`` (torch
+    tensor, numpy array, cupy array) or a legacy DLPack capsule."""
+    import jax
+
+    return jax.dlpack.from_dlpack(external)

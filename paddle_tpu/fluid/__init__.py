@@ -33,6 +33,7 @@ from paddle_tpu import backward  # noqa: F401
 from paddle_tpu import flags  # noqa: F401
 from paddle_tpu.flags import set_flags  # noqa: F401
 from paddle_tpu import recordio_writer  # noqa: F401
+from paddle_tpu import dlpack  # noqa: F401
 from paddle_tpu import nets  # noqa: F401
 
 

@@ -14,19 +14,67 @@ _UNARY_OPS = [
 __all__ = list(_UNARY_OPS) + ["uniform_random"]
 
 
+# Attr names + reference defaults for the parameterized activations
+# (reference: the op makers in paddle/fluid/operators/activation_op.cc).
+# Declaring them gives each layer an explicit signature — the API golden
+# test (tests/test_api_spec.py) no longer accepts a **kwargs stub here.
+_UNARY_ATTRS = {
+    "elu": (("alpha", 1.0),),
+    "relu6": (("threshold", 6.0),),
+    "stanh": (("scale_a", 2.0 / 3.0), ("scale_b", 1.7159)),
+    "hard_sigmoid": (("slope", 0.2), ("offset", 0.5)),
+    "swish": (("beta", 1.0),),
+    "brelu": (("t_min", 0.0), ("t_max", 24.0)),
+    "soft_relu": (("threshold", 40.0),),
+    "hard_shrink": (("threshold", 0.5),),
+    "thresholded_relu": (("threshold", 1.0),),
+}
+
+
 def _make_unary(op_type):
-    def layer(x, name=None, **kwargs):
+    import inspect
+
+    attr_spec = _UNARY_ATTRS.get(op_type)
+
+    if attr_spec is None:
+        def layer(x, name=None, **kwargs):
+            helper = LayerHelper(op_type, name=name)
+            out = helper.create_variable_for_type_inference(dtype=x.dtype)
+            helper.append_op(
+                type=op_type,
+                inputs={"X": [x]},
+                outputs={"Out": [out]},
+                attrs=kwargs,
+            )
+            return out
+
+        layer.__name__ = op_type
+        return layer
+
+    P = inspect.Parameter
+    sig = inspect.Signature(
+        [P("x", P.POSITIONAL_OR_KEYWORD)]
+        + [P(k, P.POSITIONAL_OR_KEYWORD, default=v)
+           for k, v in attr_spec]
+        + [P("name", P.POSITIONAL_OR_KEYWORD, default=None)])
+
+    def layer(*args, **kwargs):
+        bound = sig.bind(*args, **kwargs)
+        bound.apply_defaults()
+        x = bound.arguments.pop("x")
+        name = bound.arguments.pop("name")
         helper = LayerHelper(op_type, name=name)
         out = helper.create_variable_for_type_inference(dtype=x.dtype)
         helper.append_op(
             type=op_type,
             inputs={"X": [x]},
             outputs={"Out": [out]},
-            attrs=kwargs,
+            attrs=dict(bound.arguments),
         )
         return out
 
     layer.__name__ = op_type
+    layer.__signature__ = sig
     return layer
 
 

@@ -568,7 +568,8 @@ class ModelAverage(Optimizer):
                 self._avg_params.append((p, s, c, old_s, old_c))
         self._stash = {}
 
-    def minimize(self, loss, **kwargs):
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
         raise NotImplementedError(
             "ModelAverage accumulates alongside another optimizer; use "
             "apply()/restore() around evaluation")

@@ -2,8 +2,10 @@
 (VERDICT r2 row 34: the layer-only golden test under-covered — the
 reference freezes 518 entries across fluid/layers/optimizer/io/contrib/
 transpiler/reader/dataset). Every entry must resolve on the repo's
-surface, and for ArgSpec'd entries every reference argument name must be
-accepted (extra args are fine; **kwargs satisfies anything)."""
+surface, and for ArgSpec'd entries every reference argument must be an
+explicitly NAMED parameter — a bare **kwargs no longer satisfies the
+golden (VERDICT r3 Weak #8: the escape made the 518/518 claim weaker
+than it read and could not catch a **kwargs stub regression)."""
 
 import inspect
 import re
@@ -60,12 +62,9 @@ def test_api_spec_full_surface():
             except (ValueError, TypeError):
                 continue
             have = set(sig.parameters)
-            has_kw = any(
-                p.kind == inspect.Parameter.VAR_KEYWORD
-                for p in sig.parameters.values())
             lacking = [a for a in ref_args
                        if a != "self" and a not in have]
-            if lacking and not has_kw:
+            if lacking:
                 argmiss.append((path, lacking))
     assert total == 518, "spec drifted: %d entries" % total
     assert not missing, "unresolvable API.spec entries: %s" % missing

@@ -369,3 +369,28 @@ def test_unified_flags():
     assert flags.get_flag("executable_cache_size") == 7
     del os.environ["PADDLE_TPU_EXECUTABLE_CACHE_SIZE"]
     assert flags.get_flag("executable_cache_size") == 128
+
+
+def test_dlpack_interop():
+    """jax <-> torch round trips through the DLPack protocol
+    (reference: framework/dlpack_tensor.cc + dlpack_tensor_test.cc)."""
+    import jax.numpy as jnp
+    import torch
+
+    from paddle_tpu import dlpack
+
+    # framework tensor -> torch, zero-copy on CPU
+    x = jnp.arange(12.0).reshape(3, 4)
+    t = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(x))
+    assert t.shape == (3, 4)
+    np.testing.assert_array_equal(t.numpy(), np.asarray(x))
+
+    # torch -> framework tensor
+    src = torch.arange(6, dtype=torch.float32).reshape(2, 3) * 2
+    y = dlpack.from_dlpack(src)
+    np.testing.assert_array_equal(np.asarray(y), src.numpy())
+
+    # host values stage through jax transparently
+    host = np.ones((2, 2), np.float32)
+    t2 = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(host))
+    np.testing.assert_array_equal(t2.numpy(), host)
