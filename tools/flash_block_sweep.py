@@ -38,18 +38,32 @@ from tools.marginal_timing import (chained_grad_loop,  # noqa: E402
                                    run_marginal_protocol)
 
 
+def _dump(table):
+    with open(OUT, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+
+
 def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=(128, 256, 512),
           dtypes=("bfloat16", "float32"), batch=4, heads=16, dim=64,
-          reps=3, target_signal_s=3.0):
+          reps=3, target_signal_s=3.0, fresh=False):
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu.kernels.flash_attention import flash_attention
 
     assert jax.default_backend() != "cpu", "sweep needs the TPU backend"
+    # merge into the existing table so a partial re-sweep (one row, more
+    # reps) refines rather than clobbers the committed winners;
+    # fresh=True regenerates from scratch
     table = {}
+    if not fresh:
+        try:
+            with open(OUT) as f:
+                table = json.load(f)
+        except (OSError, ValueError):
+            pass
     for dtype in dtypes:
-        table[dtype] = {}
+        table.setdefault(dtype, {})
         for seq in seqs:
             rng = np.random.RandomState(0)
             # long f32 runs blow HBM sooner; shrink batch at 4096
@@ -83,8 +97,12 @@ def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=(128, 256, 512),
                 variants[blk] = (fn_lo, n_lo,
                                  chained_grad_loop(g, n_hi), n_hi)
             if not variants:
-                print("dtype=%s seq=%d: no block compiled, row omitted"
+                print("dtype=%s seq=%d: no block compiled, row dropped"
                       % (dtype, seq), flush=True)
+                # a stale committed winner measured under an older
+                # kernel must not survive a run where nothing compiles
+                table[dtype].pop(str(seq), None)
+                _dump(table)
                 continue
             measured = run_marginal_protocol(variants, (q, k, v), reps)
             # a non-positive marginal is an overhead spike, not a kernel
@@ -92,8 +110,10 @@ def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=(128, 256, 512),
             med = {blk: m for blk, (m, _) in measured.items() if m > 0}
             if not med:
                 print("dtype=%s seq=%d: all marginals drowned in "
-                      "overhead noise, row omitted" % (dtype, seq),
+                      "overhead noise, row dropped" % (dtype, seq),
                       flush=True)
+                table[dtype].pop(str(seq), None)
+                _dump(table)
                 continue
             best = min(med, key=med.get)
             table[dtype][str(seq)] = best
@@ -101,11 +121,25 @@ def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=(128, 256, 512),
                 dtype, seq, dn, best,
                 " ".join("%d:%.3fms" % (b_, m * 1e3)
                          for b_, m in sorted(med.items()))), flush=True)
-            with open(OUT, "w") as f:                # incremental dump
-                json.dump(table, f, indent=1, sort_keys=True)
+            _dump(table)                             # incremental dump
     return table
 
 
 if __name__ == "__main__":
-    sweep()
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        "flash_block_sweep",
+        description="Re-sweep all rows, or --seqs/--dtypes for one row "
+                    "with more --reps; winners merge into the table.")
+    ap.add_argument("--seqs", type=int, nargs="+",
+                    default=[256, 512, 1024, 2048, 4096])
+    ap.add_argument("--dtypes", nargs="+",
+                    default=["bfloat16", "float32"])
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore the existing table, regenerate")
+    a = ap.parse_args()
+    sweep(seqs=tuple(a.seqs), dtypes=tuple(a.dtypes), reps=a.reps,
+          fresh=a.fresh)
     print("wrote", OUT)
