@@ -36,7 +36,12 @@ import numpy as np
 
 __all__ = ["CheckpointManager"]
 
-_STEP_RE = re.compile(r"^step_(\d+)$")
+_STEP_RE = re.compile(r"^step_(\d+)(?:\.proc(\d+))?$")
+
+
+def _covers_global(idx, global_shape):
+    return idx is None or all(a == 0 and b == dim for (a, b), dim
+                              in zip(idx, global_shape))
 
 
 def _save_synced(path, arr):
@@ -79,14 +84,48 @@ class CheckpointManager:
     writing params into place one save op at a time and loses on crash.
     """
 
-    def __init__(self, root, max_to_keep=3, process_index=0):
+    def __init__(self, root, max_to_keep=3, process_index=None,
+                 process_count=None):
         self.root = root
         self.max_to_keep = max_to_keep
-        self.process_index = process_index
+        # process identity resolves LAZILY at first save: querying jax
+        # here would initialize the backend, poisoning a later
+        # jax.distributed.initialize() when the manager is constructed
+        # first (the natural script order)
+        self._proc = (process_index, process_count)
         os.makedirs(root, exist_ok=True)
         self._thread = None
         self._error = None
         self._lock = threading.Lock()
+
+    def _resolve_proc(self):
+        pi, pc = self._proc
+        if pi is None or pc is None:
+            import jax
+
+            pi = jax.process_index() if pi is None else pi
+            pc = jax.process_count() if pc is None else pc
+            self._proc = (pi, pc)
+        return pi, pc
+
+    @property
+    def process_index(self):
+        return self._resolve_proc()[0]
+
+    @property
+    def process_count(self):
+        return self._resolve_proc()[1]
+
+    def _dirname(self, step):
+        """Single-process keeps the plain 'step_N' layout; multi-host
+        processes each publish their own 'step_N.procI' directory so
+        saves on a shared filesystem never collide (each process writes
+        only the shards it OWNS — the tensorstore-style layout SURVEY §5
+        prescribes)."""
+        pi, pc = self._resolve_proc()
+        if pc <= 1:
+            return os.path.join(self.root, "step_%d" % step)
+        return os.path.join(self.root, "step_%d.proc%d" % (step, pi))
 
     # -- save --------------------------------------------------------------
     def save(self, step, arrays, blocking=False):
@@ -118,45 +157,66 @@ class CheckpointManager:
 
     def _write(self, step, snapshot):
         try:
-            tmp = os.path.join(self.root, ".tmp_step_%d" % step)
-            final = os.path.join(self.root, "step_%d" % step)
+            final = self._dirname(step)
+            tmp = os.path.join(self.root,
+                               "." + os.path.basename(final) + ".tmp")
             shutil.rmtree(tmp, ignore_errors=True)
             os.makedirs(tmp)
-            manifest = {"step": step, "process": self.process_index,
-                        "vars": {}}
+            pi, pc = self._resolve_proc()
+            manifest = {"step": step, "process": pi,
+                        "process_count": pc, "vars": {}}
             for name, arr in snapshot.items():
                 shards = getattr(arr, "addressable_shards", None)
                 fname = name.replace("/", "__")
-                shards = [] if shards is None else list(shards)
-                # dedup by slice index: a dp-replicated param has N
-                # identical full-range shards — save ONE piece, not N
-                # copies of the whole array
-                uniq = {}
+                if shards is None:
+                    # plain host value: process 0 alone writes it
+                    if pi == 0:
+                        host = np.asarray(arr)
+                        _save_synced(os.path.join(tmp, fname + ".npy"),
+                                     host)
+                        manifest["vars"][name] = {
+                            "global_shape": list(host.shape),
+                            "dtype": str(host.dtype),
+                            "pieces": [{"file": fname + ".npy",
+                                        "index": None}],
+                        }
+                    continue
+                # One writer per DISTINCT slice across the whole mesh:
+                # the lowest process index holding a slice owns it
+                # (replicated arrays and tp-sharded-but-dp-replicated
+                # params are written exactly once cluster-wide, not once
+                # per process)
+                owner = {}
+                for dev, idx in arr.sharding.devices_indices_map(
+                        arr.shape).items():
+                    key = tuple(
+                        (0 if s.start is None else int(s.start),
+                         arr.shape[d] if s.stop is None else int(s.stop))
+                        for d, s in enumerate(idx))
+                    p = getattr(dev, "process_index", 0)
+                    if key not in owner or p < owner[key]:
+                        owner[key] = p
+                written = set()
                 for sh in shards:
-                    uniq.setdefault(
-                        tuple(map(tuple, _slice_index(sh, arr.shape))),
-                        sh)
-                if len(uniq) > 1:
-                    for sh in uniq.values():
-                        idx = _slice_index(sh, arr.shape)
-                        piece = np.asarray(sh.data)   # D2H here
-                        pfile = "%s.shard%d.npy" % (fname, sh.device.id)
-                        _save_synced(os.path.join(tmp, pfile), piece)
-                        manifest["vars"].setdefault(name, {
-                            "global_shape": list(arr.shape),
-                            "dtype": str(piece.dtype),
-                            "pieces": [],
-                        })["pieces"].append(
-                            {"file": pfile, "index": idx})
-                else:
-                    host = np.asarray(arr)            # D2H here
-                    _save_synced(os.path.join(tmp, fname + ".npy"), host)
-                    manifest["vars"][name] = {
-                        "global_shape": list(host.shape),
-                        "dtype": str(host.dtype),
-                        "pieces": [{"file": fname + ".npy",
-                                    "index": None}],
-                    }
+                    key = tuple(map(tuple,
+                                    _slice_index(sh, arr.shape)))
+                    if key in written or owner.get(key) != pi:
+                        continue
+                    written.add(key)
+                    piece = np.asarray(sh.data)       # D2H here
+                    full = _covers_global(key, arr.shape)
+                    pfile = (fname + ".npy" if full
+                             else "%s.shard%d.npy" % (fname,
+                                                      sh.device.id))
+                    _save_synced(os.path.join(tmp, pfile), piece)
+                    manifest["vars"].setdefault(name, {
+                        "global_shape": list(arr.shape),
+                        "dtype": str(piece.dtype),
+                        "pieces": [],
+                    })["pieces"].append(
+                        {"file": pfile,
+                         "index": None if full else list(map(list,
+                                                             key))})
             with open(os.path.join(tmp, "manifest.json"), "w") as f:
                 json.dump(manifest, f)
                 f.flush()
@@ -164,6 +224,20 @@ class CheckpointManager:
             _fsync_dir(tmp)                # file entries durable pre-rename
             shutil.rmtree(final, ignore_errors=True)
             os.rename(tmp, final)                     # atomic publish
+            # a re-save of the same step under a DIFFERENT world size
+            # must not leave the other layout's dirs to shadow this one
+            # at restore time (process 0 cleans; peers' same-layout proc
+            # dirs are of course kept)
+            mine = os.path.basename(final)
+            if pi == 0:
+                for d in os.listdir(self.root):
+                    m = _STEP_RE.match(d)
+                    if not m or int(m.group(1)) != step or d == mine:
+                        continue
+                    other_layout = (m.group(2) is not None) != (pc > 1)
+                    if other_layout:
+                        shutil.rmtree(os.path.join(self.root, d),
+                                      ignore_errors=True)
             _fsync_dir(self.root)                     # durable dir entry
             self._gc()
         except Exception as e:                        # noqa: BLE001
@@ -171,9 +245,18 @@ class CheckpointManager:
 
     def _gc(self):
         steps = self.all_steps()
-        for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
-            shutil.rmtree(os.path.join(self.root, "step_%d" % s),
-                          ignore_errors=True)
+        if not self.max_to_keep or not steps:
+            return
+        kept = steps[-self.max_to_keep:]
+        # prune everything OLDER than the kept window — including
+        # incomplete orphans from crashed saves, which never appear in
+        # all_steps and would otherwise accumulate forever. Dirs newer
+        # than the newest complete step are in-progress peers: kept.
+        for d in os.listdir(self.root):
+            m = _STEP_RE.match(d)
+            if m and int(m.group(1)) < kept[0]:
+                shutil.rmtree(os.path.join(self.root, d),
+                              ignore_errors=True)
 
     # -- lifecycle ---------------------------------------------------------
     def wait(self):
@@ -194,38 +277,84 @@ class CheckpointManager:
         return t is not None and t.is_alive()
 
     # -- restore -----------------------------------------------------------
-    def all_steps(self):
-        steps = []
+    def _step_dirs(self, step=None):
+        """{step: [dir, ...]} of COMPLETE checkpoints (every process dir
+        named by the recorded process_count must be present). When a
+        root holds BOTH layouts for one step (re-saved under a different
+        world size and the cleanup raced), the set with the newest
+        manifest wins — never a silent mix."""
+        found = {}
         for d in os.listdir(self.root):
             m = _STEP_RE.match(d)
-            if m and os.path.exists(
-                    os.path.join(self.root, d, "manifest.json")):
-                steps.append(int(m.group(1)))
-        return sorted(steps)
+            if not m:
+                continue
+            path = os.path.join(self.root, d, "manifest.json")
+            if not os.path.exists(path):
+                continue
+            s = int(m.group(1))
+            if step is not None and s != step:
+                continue
+            is_proc = m.group(2) is not None
+            found.setdefault(s, {}).setdefault(is_proc, []).append(
+                os.path.join(self.root, d))
+        complete = {}
+        for s, by_layout in found.items():
+            candidates = []
+            for dirs in by_layout.values():
+                with open(os.path.join(sorted(dirs)[0],
+                                       "manifest.json")) as f:
+                    want = json.load(f).get("process_count", 1)
+                if len(dirs) >= want:
+                    newest = max(os.path.getmtime(
+                        os.path.join(d, "manifest.json")) for d in dirs)
+                    candidates.append((newest, sorted(dirs)))
+            if candidates:
+                complete[s] = max(candidates)[1]
+        return complete
+
+    def all_steps(self):
+        return sorted(self._step_dirs())
 
     def latest_step(self):
         steps = self.all_steps()
         return steps[-1] if steps else None
 
     def restore(self, step=None):
-        """-> {name: np.ndarray} reassembled to global shape."""
+        """-> {name: np.ndarray} reassembled to global shape, merging
+        every process's manifest (multi-host layouts)."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint under %s" % self.root)
-        d = os.path.join(self.root, "step_%d" % step)
-        with open(os.path.join(d, "manifest.json")) as f:
-            manifest = json.load(f)
+        dirs = self._step_dirs(step).get(step)
+        if not dirs:
+            raise FileNotFoundError(
+                "checkpoint step %s incomplete or absent under %s"
+                % (step, self.root))
         out = {}
-        for name, spec in manifest["vars"].items():
-            pieces = spec["pieces"]
-            if len(pieces) == 1 and pieces[0]["index"] is None:
-                out[name] = np.load(os.path.join(d, pieces[0]["file"]))
-                continue
-            full = np.zeros(spec["global_shape"],
-                            np.dtype(spec["dtype"]))
-            for p in pieces:
-                arr = np.load(os.path.join(d, p["file"]))
-                sl = tuple(slice(a, b) for a, b in p["index"])
-                full[sl] = arr
-            out[name] = full
+        filled = {}
+        for d in dirs:
+            with open(os.path.join(d, "manifest.json")) as f:
+                manifest = json.load(f)
+            for name, spec in manifest["vars"].items():
+                pieces = spec["pieces"]
+                if name not in out:
+                    if (len(pieces) == 1 and pieces[0]["index"] is None
+                            and len(dirs) == 1):
+                        out[name] = np.load(
+                            os.path.join(d, pieces[0]["file"]))
+                        continue
+                    out[name] = np.zeros(spec["global_shape"],
+                                         np.dtype(spec["dtype"]))
+                    filled[name] = set()
+                full = out[name]
+                for p in pieces:
+                    key = (None if p["index"] is None
+                           else tuple(map(tuple, p["index"])))
+                    if key in filled.get(name, set()):
+                        continue   # replicated piece seen from a peer
+                    arr = np.load(os.path.join(d, p["file"]))
+                    sl = (tuple(slice(a, b) for a, b in p["index"])
+                          if p["index"] is not None else Ellipsis)
+                    full[sl] = arr
+                    filled.setdefault(name, set()).add(key)
         return out

@@ -49,8 +49,17 @@ def main():
             (loss,) = exe.run(compiled, feed=batch,
                               fetch_list=[h["loss"]])
             losses.append(float(np.asarray(loss).reshape(-1)[0]))
+        # distributed checkpoint: every process saves its own shard dir
+        # through the async manager (tensorstore-style layout)
+        ckpt_dir = os.environ.get("CLUSTER_CKPT_DIR")
+        if ckpt_dir:
+            fluid.io.save_checkpoint_async(
+                fluid.io.CheckpointManager(ckpt_dir), step=4,
+                main_program=main_prog, scope=scope, blocking=True)
+    param_names = [p.name for p in main_prog.all_parameters()]
     print("CLUSTER_RESULT " + json.dumps(
-        {"rank": info["rank"], "losses": losses}), flush=True)
+        {"rank": info["rank"], "losses": losses,
+         "param_names": param_names}), flush=True)
 
 
 if __name__ == "__main__":

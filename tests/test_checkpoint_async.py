@@ -150,3 +150,48 @@ def test_sharded_array_reassembly(tmp_path):
     files = os.listdir(str(tmp_path / "ckpt" / "step_1"))
     assert sum(f.startswith("x.shard") for f in files) == 2
     np.testing.assert_array_equal(mgr.restore(1)["x"], x)
+
+
+def test_orphan_gc_and_layout_preference(tmp_path):
+    """Incomplete proc-layout orphans older than the kept window are
+    pruned, and a step present in BOTH layouts restores from the newest
+    complete set (round-4 review findings)."""
+    import json
+    import time as _time
+
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, max_to_keep=2, process_index=0,
+                            process_count=1)
+    for s in (1, 2, 3):
+        mgr.save(s, {"v": np.full((2,), float(s))}, blocking=True)
+    # fabricate an INCOMPLETE older multi-host orphan (proc1 of 2 only)
+    orphan = os.path.join(root, "step_0.proc1")
+    os.makedirs(orphan)
+    with open(os.path.join(orphan, "manifest.json"), "w") as f:
+        json.dump({"step": 0, "process": 1, "process_count": 2,
+                   "vars": {}}, f)
+    assert mgr.all_steps() == [2, 3]   # orphan invisible
+    mgr.save(4, {"v": np.full((2,), 4.0)}, blocking=True)
+    assert not os.path.exists(orphan), "orphan survived gc"
+
+    # same step in both layouts: the newer (proc) set wins at restore
+    stale = os.path.join(root, "step_9")
+    os.makedirs(stale)
+    np.save(os.path.join(stale, "v.npy"), np.full((2,), -1.0))
+    with open(os.path.join(stale, "manifest.json"), "w") as f:
+        json.dump({"step": 9, "process": 0, "process_count": 1,
+                   "vars": {"v": {"global_shape": [2],
+                                  "dtype": "float64",
+                                  "pieces": [{"file": "v.npy",
+                                              "index": None}]}}}, f)
+    _time.sleep(0.05)
+    fresh = os.path.join(root, "step_9.proc0")
+    os.makedirs(fresh)
+    np.save(os.path.join(fresh, "v.npy"), np.full((2,), 9.0))
+    with open(os.path.join(fresh, "manifest.json"), "w") as f:
+        json.dump({"step": 9, "process": 0, "process_count": 1,
+                   "vars": {"v": {"global_shape": [2],
+                                  "dtype": "float64",
+                                  "pieces": [{"file": "v.npy",
+                                              "index": None}]}}}, f)
+    assert mgr.restore(9)["v"][0] == 9.0, "stale layout shadowed fresh"

@@ -42,7 +42,9 @@ def _single_process_losses():
             (loss,) = exe.run(compiled, feed=batch,
                               fetch_list=[h["loss"]])
             losses.append(float(np.asarray(loss).reshape(-1)[0]))
-    return losses
+        params = {p.name: np.asarray(scope.get(p.name))
+                  for p in main_prog.all_parameters()}
+    return losses, params
 
 
 def test_two_process_cluster_matches_single_process():
@@ -51,8 +53,11 @@ def test_two_process_cluster_matches_single_process():
     worker = os.path.join(REPO, "tests", "spmd_cluster_worker.py")
     # the launcher's endpoint list doubles as the coordinator address
     # (rank 0's endpoint), exactly as init_distributed consumes it
+    import tempfile
+
     port = _free_port()
-    env_extra = {}
+    ckpt_dir = tempfile.mkdtemp(prefix="cluster_ckpt_")
+    env_extra = {"CLUSTER_CKPT_DIR": ckpt_dir}
     for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
         env_extra[var] = ""   # the worker sets its own platform config
     procs = launch_processes([worker], nproc=2, started_port=port,
@@ -80,9 +85,37 @@ def test_two_process_cluster_matches_single_process():
     # both ranks computed the SAME global step
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
 
-    single = _single_process_losses()
+    single, single_params = _single_process_losses()
     # same math as one process over 8 local devices: parity within
     # float-reassociation tolerance (cross-host collectives reassociate)
     np.testing.assert_allclose(results[0], single, rtol=1e-4, atol=1e-5)
     # and it genuinely trains
     assert results[0][-1] < results[0][0]
+
+    # the distributed checkpoint written by BOTH processes (each its own
+    # proc dir) restores to the full global params — compared against
+    # the single-process run, which computed the same 4 steps
+    import json as _json
+    import shutil
+
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    try:
+        mgr = CheckpointManager(ckpt_dir, process_count=1)
+        assert mgr.all_steps() == [4], os.listdir(ckpt_dir)
+        data = mgr.restore(4)
+        r0 = _json.loads([l for l in outs[0].decode().splitlines()
+                          if l.startswith("CLUSTER_RESULT ")][0][15:])
+        # worker and parent builds produce the same param-name sequence
+        # (each a fresh unique_name space); align positionally
+        single_names = list(single_params)
+        for wname, sname in zip(r0["param_names"], single_names):
+            got = data[wname]
+            want = single_params[sname]
+            assert got.shape == want.shape, (wname, sname)
+            np.testing.assert_allclose(
+                got, want, rtol=1e-3, atol=1e-4,
+                err_msg="restored %s != single-process %s"
+                        % (wname, sname))
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
