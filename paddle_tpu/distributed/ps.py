@@ -367,11 +367,18 @@ class ParameterServer:
             # shard-local row gather (reference:
             # request_handler_impl.cc RequestPrefetchHandler); gather
             # BEFORE np.asarray so a device-resident table transfers
-            # only the requested rows, not the whole shard
+            # only the requested rows, not the whole shard. Materialize
+            # UNDER the lock: a concurrent optimize block donates the
+            # old buffers, and reading a donated jax array raises
+            # "Array has been deleted".
             _, name, ids = msg
-            table = self.scope.get(name)
-            rows = np.asarray(table[ids.astype(np.int64)])
-            _send_msg(conn, ("var", rows))
+            with self._lock:
+                table = self.scope.get(name)
+                # the gather DISPATCH happens under the lock (so it is
+                # enqueued before any later optimize block can donate
+                # the table buffer); the host transfer runs outside it
+                rows_dev = table[ids.astype(np.int64)]
+            _send_msg(conn, ("var", np.asarray(rows_dev)))
         elif kind == "batch_barrier":
             if not self.sync_mode:
                 # async mode has no barriers (RunAsyncLoop)
@@ -405,8 +412,19 @@ class ParameterServer:
             else:
                 _send_msg(conn, ("ok",))
         elif kind == "get":
+            # Take a donation-safe reference UNDER the lock (the
+            # round-3 "EOF race" was this read racing an optimize
+            # block's buffer donation; the typed RpcError of round 4
+            # finally named it): device arrays get a cheap on-device
+            # copy enqueued before any later donation can be, host
+            # values are rebind-immutable. The expensive
+            # device-to-host transfer then runs OUTSIDE the lock so N
+            # trainers' param pulls stay concurrent.
             _, name = msg
-            val = self.scope.get(name)
+            with self._lock:
+                val = self.scope.get(name)
+                if hasattr(val, "addressable_shards"):
+                    val = val.copy()
             if val is None:
                 raise KeyError("var %r not hosted on %s"
                                % (name, self.endpoint))

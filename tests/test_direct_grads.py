@@ -1,5 +1,6 @@
 """Finite-difference checks for the DIRECT grad lowerings (conv2d_grad,
-depthwise_conv2d_grad, batch_norm_grad, mul_grad, matmul_grad) that replace
+depthwise_conv2d_grad, batch_norm_grad, mul_grad, matmul_grad, gelu_grad,
+softmax_with_cross_entropy_grad) that replace
 the generic jax.vjp path for the hot ops (reference: the hand-written grad
 kernels conv_cudnn_op.cu.cc, batch_norm_op.cc, mul_op.cc, matmul_op.cc)."""
 
@@ -193,3 +194,12 @@ def test_softmax_with_cross_entropy_soft_and_softmax_branch():
     want = (sm64 - p_soft)  # soft CE part (sum over rows, dLoss=1)
     want = want + sm64 * (w - (w * sm64).sum(1, keepdims=True))
     np.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
+
+
+class TestGeluGrad(OpTest):
+    @pytest.mark.parametrize("approximate", [False, True])
+    def test_both_forms(self, approximate):
+        rng = np.random.RandomState(7)
+        x = rng.randn(4, 6).astype(np.float32)
+        self.check_grad("gelu", {"X": [("x", x)]}, "x",
+                        attrs={"approximate": approximate})
