@@ -78,19 +78,31 @@ def _keep_mask(seed, b, q_pos, k_pos, t_k, rate):
 
 def _nk_limit(nk, causal_hi, length, block_k, masked, causal):
     """Number of K blocks that can contribute: min over the causal frontier
-    and the valid-key frontier (both dynamic-friendly fori_loop bounds)."""
+    and the valid-key frontier (both dynamic-friendly fori_loop bounds).
+
+    ``causal_hi`` may be 0 or negative when the whole Q tile precedes the
+    K range (a ring-attention step holding a future K/V block) — the loop
+    then runs zero iterations and the row publishes lse ~= -1e30, which
+    the cross-step logaddexp merge treats as "no contribution". The
+    masked limit is >= 1 by construction (lengths are clamped upstream)."""
     nk_eff = nk
     if causal:
-        nk_eff = jnp.minimum(nk_eff, causal_hi)
+        nk_eff = jnp.clip(causal_hi, 0, nk)
     if masked:
         nk_eff = jnp.minimum(nk_eff, (length + block_k - 1) // block_k)
-    if causal or masked:
-        nk_eff = jnp.maximum(nk_eff, 1)
     return nk_eff
 
 
-def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                 block_q, block_k, causal, scale, rate, masked):
+def _causal_blocks(q_off, k_off, j, block_q, block_k):
+    """Dynamic count of K blocks at or before the causal frontier of Q
+    block ``j``, with Q/K living at global offsets ``q_off``/``k_off``
+    (SMEM scalars — the ring-attention caller passes shard*T). Floor
+    division handles the fully-masked (negative) case."""
+    return (q_off - k_off + (j + 1) * block_q - 1) // block_k + 1
+
+
+def _attn_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
+                 lse_ref, *, block_q, block_k, causal, scale, rate, masked):
     b = pl.program_id(0)
     j = pl.program_id(1)
     q = q_ref[0]  # [block_q, D], kept in input dtype for MXU-rate matmuls
@@ -98,6 +110,7 @@ def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     nk = t_k // block_k
     length = len_ref[b]
     seed = seed_ref[0]
+    q_off, k_off = off_ref[0], off_ref[1]
 
     q_pos = j * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -112,7 +125,7 @@ def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         k_pos = s * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         if causal:
-            sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+            sij = jnp.where(q_pos + q_off >= k_pos + k_off, sij, _NEG)
         if masked:
             sij = jnp.where(k_pos < length, sij, _NEG)
         m_new = jnp.maximum(m, jnp.max(sij, axis=-1, keepdims=True))
@@ -133,7 +146,7 @@ def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
 
-    causal_hi = (j + 1) * block_q // block_k + (1 if block_q % block_k else 0)
+    causal_hi = _causal_blocks(q_off, k_off, j, block_q, block_k)
     nk_eff = _nk_limit(nk, causal_hi, length, block_k, masked, causal)
     acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
@@ -144,8 +157,16 @@ def _attn_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LSE_LANES))
 
 
-def _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate, block_q,
-                   block_k, interpret):
+def _offsets_arr(offsets):
+    """[q_off, k_off] int32 SMEM scalars — the Q/K global base positions
+    (ring-attention shard offsets); [0, 0] for ordinary full attention."""
+    if offsets is None:
+        return jnp.zeros((2,), jnp.int32)
+    return jnp.asarray(offsets, jnp.int32).reshape(2)
+
+
+def _flash_forward(q, k, v, seq_lens, offsets, seed, causal, scale, rate,
+                   block_q, block_k, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     qr = q.reshape(B * H, Tq, D)
@@ -175,6 +196,7 @@ def _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate, block_q,
         in_specs=[
             _smem_spec(),
             _smem_spec(),
+            _smem_spec(),
             pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
@@ -184,13 +206,13 @@ def _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate, block_q,
             pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, j: (b, j, 0)),
         ],
         interpret=interpret,
-    )(lens, seed_arr, qr, kr, vr)
+    )(lens, seed_arr, _offsets_arr(offsets), qr, kr, vr)
     return out.reshape(B, H, Tq, D), lse
 
 
-def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, block_q, block_k, causal, scale,
-                   rate, masked):
+def _bwd_dq_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, *, block_q, block_k, causal,
+                   scale, rate, masked):
     b = pl.program_id(0)
     j = pl.program_id(1)
     q = q_ref[0]                              # [block_q, D]
@@ -201,6 +223,7 @@ def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     nk = t_k // block_k
     length = len_ref[b]
     seed = seed_ref[0]
+    q_off, k_off = off_ref[0], off_ref[1]
     q_pos = j * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
@@ -213,7 +236,7 @@ def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k_pos = s * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         if causal:
-            sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+            sij = jnp.where(q_pos + q_off >= k_pos + k_off, sij, _NEG)
         if masked:
             sij = jnp.where(k_pos < length, sij, _NEG)
         p = jnp.exp(sij - lse)
@@ -228,16 +251,16 @@ def _bwd_dq_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    causal_hi = (j + 1) * block_q // block_k + (1 if block_q % block_k else 0)
+    causal_hi = _causal_blocks(q_off, k_off, j, block_q, block_k)
     nk_eff = _nk_limit(nk, causal_hi, length, block_k, masked, causal)
     dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
     dq = jax.lax.fori_loop(0, nk_eff, body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, block_q, block_k, causal,
-                    scale, rate, masked):
+def _bwd_dkv_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, block_k,
+                    causal, scale, rate, masked):
     b = pl.program_id(0)
     s_idx = pl.program_id(1)
     k_blk = k_ref[0]                           # [block_k, D]
@@ -247,6 +270,7 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     nq = t_q // block_q
     length = len_ref[b]
     seed = seed_ref[0]
+    q_off, k_off = off_ref[0], off_ref[1]
     k_pos = s_idx * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
 
@@ -262,7 +286,7 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         q_pos = j * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         if causal:
-            sij = jnp.where(q_pos >= k_pos, sij, _NEG)
+            sij = jnp.where(q_pos + q_off >= k_pos + k_off, sij, _NEG)
         if masked:
             sij = jnp.where(k_pos < length, sij, _NEG)
         p = jnp.exp(sij - lse)                 # [block_q, block_k]
@@ -288,8 +312,10 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         return dk, dv
 
     if causal:
-        # q blocks strictly before this k block's first row see none of it
-        j0 = (s_idx * block_k) // block_q
+        # q blocks strictly before this k block's first global row see
+        # none of it; with offsets the frontier can also put the whole Q
+        # range before the K block (j0 clamps to nq -> empty loop)
+        j0 = jnp.clip((k_off + s_idx * block_k - q_off) // block_q, 0, nq)
     else:
         j0 = 0
     dk0 = jnp.zeros((block_k, k_ref.shape[2]), jnp.float32)
@@ -299,8 +325,8 @@ def _bwd_dkv_kernel(len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, seq_lens, seed, causal, scale,
-                    rate, block_q, block_k, interpret):
+def _flash_backward(q, k, v, out, lse, g, g_lse, seq_lens, offsets, seed,
+                    causal, scale, rate, block_q, block_k, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     qr = q.reshape(B * H, Tq, D)
@@ -316,12 +342,18 @@ def _flash_backward(q, k, v, out, lse, g, seq_lens, seed, causal, scale,
     else:
         lens = jnp.full((B * H,), Tk, jnp.int32)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1)
+    off_arr = _offsets_arr(offsets)
 
     # delta = rowsum(dO * O): cheap elementwise, XLA fuses it; replicated
-    # across the lane dim like lse so its blocks stay Mosaic-tileable
+    # across the lane dim like lse so its blocks stay Mosaic-tileable.
+    # A cotangent on the published logsumexp (the ring-attention merge
+    # differentiates through lse) folds in exactly: d s from g_lse is
+    # p * g_lse, and ds = p * (dp - delta + g_lse) — so delta -= g_lse.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.reshape(B * H, Tq, D).astype(
             jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.reshape(B * H, Tq).astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (B * H, Tq, _LSE_LANES))
 
     dq = pl.pallas_call(
@@ -333,6 +365,7 @@ def _flash_backward(q, k, v, out, lse, g, seq_lens, seed, causal, scale,
         in_specs=[
             _smem_spec(),
             _smem_spec(),
+            _smem_spec(),
             pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
@@ -342,7 +375,7 @@ def _flash_backward(q, k, v, out, lse, g, seq_lens, seed, causal, scale,
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
         interpret=interpret,
-    )(lens, seed_arr, qr, kr, vr, do, lse, delta)
+    )(lens, seed_arr, off_arr, qr, kr, vr, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -354,6 +387,7 @@ def _flash_backward(q, k, v, out, lse, g, seq_lens, seed, causal, scale,
         ],
         grid=(B * H, Tk // block_k),
         in_specs=[
+            _smem_spec(),
             _smem_spec(),
             _smem_spec(),
             pl.BlockSpec((1, Tq, D), lambda b, s: (b, 0, 0)),
@@ -368,17 +402,14 @@ def _flash_backward(q, k, v, out, lse, g, seq_lens, seed, causal, scale,
             pl.BlockSpec((1, block_k, D), lambda b, s: (b, s, 0)),
         ],
         interpret=interpret,
-    )(lens, seed_arr, qr, kr, vr, do, lse, delta)
+    )(lens, seed_arr, off_arr, qr, kr, vr, do, lse, delta)
 
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
 
 
-def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
-                   rng_key=None):
-    """Unfused reference composition (and the off-TPU fallback). With
-    dropout it draws its own jax.random mask — statistically, not
-    bitwise, equivalent to the kernel's hash RNG."""
+def _xla_scores(q, k, causal, scale, seq_lens):
+    """Masked, scaled [B, H, Tq, Tk] scores of the unfused composition."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     Tq, Tk = q.shape[2], k.shape[2]
@@ -390,6 +421,26 @@ def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
         valid = k_pos < jnp.maximum(seq_lens.astype(jnp.int32), 1).reshape(
             -1, 1, 1, 1)
         s = jnp.where(valid, s, _NEG)
+    return s
+
+
+def _xla_attention_lse(q, k, v, causal, scale, seq_lens=None):
+    """(out, lse) in plain XLA — the differentiable fallback matching
+    ``flash_attention_lse``'s two outputs (used by the PADDLE_TPU_FLASH_BWD
+    escape hatch so an lse cotangent is never dropped)."""
+    s = _xla_scores(q, k, causal, scale, seq_lens)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    w = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
+
+
+def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
+                   rng_key=None):
+    """Unfused reference composition (and the off-TPU fallback). With
+    dropout it draws its own jax.random mask — statistically, not
+    bitwise, equivalent to the kernel's hash RNG."""
+    s = _xla_scores(q, k, causal, scale, seq_lens)
     w = jax.nn.softmax(s, axis=-1)
     if rate > 0.0:
         from paddle_tpu.ops.common import hash_keep_mask
@@ -454,10 +505,23 @@ def pick_block(t, dtype=None):
     return 256 if t % 256 == 0 and t >= 256 else 128
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def flash_attention(q, k, v, seq_lens=None, seed=0, causal=False, scale=None,
-                    rate=0.0, block_q=128, block_k=128, interpret=False):
-    """[B, H, T, D] attention via the Pallas kernels.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def flash_attention_lse(q, k, v, seq_lens=None, offsets=None, seed=0,
+                        causal=False, scale=None, rate=0.0, block_q=128,
+                        block_k=128, interpret=False):
+    """[B, H, T, D] attention via the Pallas kernels, returning
+    ``(out, lse)`` where ``lse`` is the per-row logsumexp of the scaled
+    (and masked) scores, [B, H, Tq] float32.
+
+    This is the ring-attention building block: ``offsets`` ([2] int32,
+    traced — [q_off, k_off]) places the Q and K blocks at global sequence
+    positions so causal masking works across ring steps, and the exposed
+    lse lets the caller merge per-step partial outputs with the standard
+    logaddexp rescaling. A Q tile entirely before the K range contributes
+    zero rows with lse ~= -1e30, which the merge maps to weight 0. The
+    lse cotangent is folded into the backward kernels' delta (see
+    ``_flash_backward``), so differentiating through the merge costs no
+    extra kernel.
 
     ``seq_lens`` ([B] int) masks keys at positions >= len (padding mask);
     lengths are clamped to >= 1, so a fully-empty sequence attends to key
@@ -467,10 +531,18 @@ def flash_attention(q, k, v, seq_lens=None, seed=0, causal=False, scale=None,
     kernels from ``seed``. Tq/Tk must divide by the (clamped) block sizes
     (ValueError otherwise — ``fused_attention`` handles the fallback).
     """
-    _check_tileable(q, k, block_q, block_k)
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    out, _ = _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate,
-                            block_q, block_k, interpret)
+    out, lse = _fa_fwd(q, k, v, seq_lens, offsets, seed, causal, scale,
+                       rate, block_q, block_k, interpret)[0]
+    return out, lse
+
+
+def flash_attention(q, k, v, seq_lens=None, seed=0, causal=False, scale=None,
+                    rate=0.0, block_q=128, block_k=128, interpret=False):
+    """[B, H, T, D] attention via the Pallas kernels (output only — see
+    ``flash_attention_lse`` for semantics; this keeps the historical
+    signature used by the op lowerings and the benchmarks)."""
+    out, _ = flash_attention_lse(q, k, v, seq_lens, None, seed, causal,
+                                 scale, rate, block_q, block_k, interpret)
     return out
 
 
@@ -480,17 +552,20 @@ def _use_xla_bwd():
     return _flags.get_flag("flash_bwd") == "xla"
 
 
-def _fa_fwd(q, k, v, seq_lens, seed, causal, scale, rate, block_q, block_k,
-            interpret):
+def _fa_fwd(q, k, v, seq_lens, offsets, seed, causal, scale, rate, block_q,
+            block_k, interpret):
     _check_tileable(q, k, block_q, block_k)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    out, lse = _flash_forward(q, k, v, seq_lens, seed, causal, scale, rate,
-                              block_q, block_k, interpret)
-    return out, (q, k, v, out, lse, seq_lens, seed)
+    out, lse = _flash_forward(q, k, v, seq_lens, offsets, seed, causal,
+                              scale, rate, block_q, block_k, interpret)
+    B, H, Tq = q.shape[0], q.shape[1], q.shape[2]
+    lse_pub = lse[..., 0].reshape(B, H, Tq)
+    return (out, lse_pub), (q, k, v, out, lse, seq_lens, offsets, seed)
 
 
 def _fa_bwd(causal, scale, rate, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse, seq_lens, seed = res
+    q, k, v, out, lse, seq_lens, offsets, seed = res
+    g_out, g_lse = g
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     Tq, Tk = q.shape[2], k.shape[2]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
@@ -500,19 +575,25 @@ def _fa_bwd(causal, scale, rate, block_q, block_k, interpret, res, g):
                 "PADDLE_TPU_FLASH_BWD=xla cannot be combined with in-kernel "
                 "attention dropout: XLA cannot reproduce the kernel's hash "
                 "mask. Unset the flag or set dropout_rate=0.")
+        if offsets is not None:
+            raise RuntimeError(
+                "PADDLE_TPU_FLASH_BWD=xla cannot differentiate the "
+                "offset (ring-step) form; unset the flag.")
         # escape hatch: recompute attention in XLA (O(T^2) intermediates)
-        # for chips where the backward kernels fail to lower
+        # for chips where the backward kernels fail to lower. Differentiate
+        # the (out, lse) pair so a caller's lse cotangent is not dropped.
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, scale_,
-                                              seq_lens),
+            lambda q_, k_, v_: _xla_attention_lse(q_, k_, v_, causal,
+                                                  scale_, seq_lens),
             q, k, v)
-        return (*vjp(g), None, None)
-    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, seq_lens, seed,
-                                 causal, scale_, rate, bq, bk, interpret)
-    return dq, dk, dv, None, None
+        return (*vjp((g_out, g_lse)), None, None, None)
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g_out, g_lse, seq_lens,
+                                 offsets, seed, causal, scale_, rate, bq, bk,
+                                 interpret)
+    return dq, dk, dv, None, None, None
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+flash_attention_lse.defvjp(_fa_fwd, _fa_bwd)
 
 
 def _on_tpu():
@@ -529,6 +610,18 @@ def _flash_min_seq():
         return int(_flags.get_flag("flash_min_seq"))
     except ValueError:  # pragma: no cover
         return 256
+
+
+def flash_dispatch_ok(tq, tk):
+    """Whether the Pallas kernels apply to a (Tq, Tk) attention: pallas-TPU
+    importable, real TPU backend, tileable blocks, and at least
+    PADDLE_TPU_FLASH_MIN_SEQ keys (the measured crossover — see
+    ``fused_attention``). The single dispatch predicate shared by
+    ``fused_attention`` and the ring-attention body so the two paths can
+    never diverge."""
+    tileable = tq % min(128, tq) == 0 and tk % min(128, tk) == 0
+    return (_HAS_PLTPU and _on_tpu() and tileable
+            and tk >= _flash_min_seq())
 
 
 def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
@@ -548,10 +641,8 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
     kernel in interpreter mode off-TPU (tests)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     Tq, Tk = q.shape[2], k.shape[2]
-    tileable = Tq % min(128, Tq) == 0 and Tk % min(128, Tk) == 0
-    use_pallas = force_pallas if force_pallas is not None else (
-        _HAS_PLTPU and _on_tpu() and tileable
-        and Tk >= _flash_min_seq())
+    use_pallas = (force_pallas if force_pallas is not None
+                  else flash_dispatch_ok(Tq, Tk))
     if use_pallas:
         return flash_attention(q, k, v, seq_lens, seed, causal, scale,
                                dropout_rate,

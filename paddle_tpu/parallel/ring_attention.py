@@ -9,8 +9,18 @@ softmax (flash-attention accumulation), then passes K/V to its ring
 neighbor with ``lax.ppermute`` — exact attention with O(T/n) memory per
 device and comm overlapped across steps.
 
+The local contraction is the Pallas flash kernel whenever it can lower
+(TPU backend, tileable block) — ``flash_attention_lse`` takes the ring
+step's global (q_off, k_off) positions for causal masking and returns the
+per-row logsumexp, and per-step partial outputs merge across steps with
+the standard logaddexp rescaling, so the multi-chip long-context path
+runs each step at single-chip kernel speed instead of materializing
+[t, t] score blocks in XLA. The plain einsum body remains the fallback
+for odd shapes / non-TPU backends.
+
 Differentiable end-to-end: the ring is a ``lax.scan`` and ppermute has a
-transpose rule, so BPTT through the ring needs no custom vjp.
+transpose rule, so BPTT through the ring needs no custom vjp; the flash
+step's lse cotangent folds into the backward kernels' delta.
 """
 
 import functools
@@ -84,12 +94,63 @@ def _ring_body(q_blk, k_blk, v_blk, axis_name, n_shards, causal, scale):
     return out.astype(in_dtype)
 
 
+def _ring_body_flash(q_blk, k_blk, v_blk, axis_name, n_shards, causal,
+                     scale, block, interpret):
+    """Flash-kernel ring body: each step contracts the local Q block
+    against the in-hand K/V block with the Pallas kernel at the step's
+    global (q_off, k_off) positions, then merges the normalized partial
+    output via its logsumexp:
+
+        lse' = logaddexp(lse, lse_i)
+        o'   = o * exp(lse - lse') + o_i * exp(lse_i - lse')
+
+    A fully-causally-masked step publishes lse_i ~= -1e30 and drops out of
+    the merge with weight exp(-1e30 - lse') = 0. The merge runs in fp32
+    and is plain XLA, so scan-transpose BPTT differentiates it and each
+    step's flash vjp runs the backward kernels (dk/dv cotangents ride the
+    ppermute transpose back around the ring)."""
+    from paddle_tpu.kernels.flash_attention import flash_attention_lse
+
+    in_dtype = q_blk.dtype
+    idx = lax.axis_index(axis_name)
+    t = q_blk.shape[2]
+    B, H = q_blk.shape[0], q_blk.shape[1]
+
+    o0 = jnp.zeros(q_blk.shape, jnp.float32)
+    lse0 = jnp.full((B, H, t), _NEG, jnp.float32)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def step(carry, i):
+        o, lse, k_cur, v_cur = carry
+        src = (idx - i) % n_shards  # whose K/V block we hold this step
+        offsets = jnp.stack([idx * t, src * t]).astype(jnp.int32)
+        o_i, lse_i = flash_attention_lse(
+            q_blk, k_cur, v_cur, None, offsets, 0, causal, scale, 0.0,
+            block, block, interpret)
+        lse_new = jnp.logaddexp(lse, lse_i)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o_i.astype(jnp.float32) * jnp.exp(lse_i - lse_new)[..., None])
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, lse_new, k_nxt, v_nxt), None
+
+    (o, _, _, _), _ = lax.scan(
+        step, (o0, lse0, k_blk, v_blk), jnp.arange(n_shards))
+    return o.astype(in_dtype)
+
+
 def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
-                   scale=None, batch_axis=None):
+                   scale=None, batch_axis=None, use_flash=None,
+                   interpret=False):
     """Exact attention with the sequence axis sharded over ``axis_name``.
 
     q, k, v: [B, H, T, D]; T must divide by the sp axis size. Usable inside
-    jit (shard_map traces into the surrounding computation)."""
+    jit (shard_map traces into the surrounding computation).
+
+    ``use_flash``: None (auto — Pallas kernel on TPU for tileable local
+    blocks of at least PADDLE_TPU_FLASH_MIN_SEQ keys, einsum fallback
+    elsewhere), True (force the kernel; pass ``interpret=True`` off-TPU),
+    or False (force the einsum body)."""
     from paddle_tpu.parallel.mesh import get_default_mesh
 
     mesh = mesh or get_default_mesh()
@@ -99,11 +160,25 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
             "seq len %d not divisible by %s=%d" % (q.shape[2], axis_name, n))
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
+    t = q.shape[2] // n
+
+    if use_flash is None:
+        from paddle_tpu.kernels.flash_attention import flash_dispatch_ok
+
+        use_flash = flash_dispatch_ok(t, t)
+    if use_flash:
+        from paddle_tpu.kernels.flash_attention import pick_block
+
+        body = functools.partial(
+            _ring_body_flash, axis_name=axis_name, n_shards=n,
+            causal=causal, scale=scale, block=pick_block(t, q.dtype),
+            interpret=interpret)
+    else:
+        body = functools.partial(
+            _ring_body, axis_name=axis_name, n_shards=n, causal=causal,
+            scale=scale)
 
     spec = P(batch_axis, None, axis_name, None)
-    body = functools.partial(
-        _ring_body, axis_name=axis_name, n_shards=n, causal=causal,
-        scale=scale)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),
