@@ -149,6 +149,12 @@ def _attn_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
     causal_hi = _causal_blocks(q_off, k_off, j, block_q, block_k)
     nk_eff = _nk_limit(nk, causal_hi, length, block_k, masked, causal)
     acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+    # a row with EVERY key masked keeps m at _NEG, making p = exp(0) = 1
+    # garbage — zero it so the row publishes out = 0, lse ~= -1e30 (the
+    # "no contribution" value the ring merge expects). Without this guard
+    # only block-aligned offsets would be safe.
+    l = jnp.where(m > 0.5 * _NEG, l, 0.0)
+    acc = jnp.where(m > 0.5 * _NEG, acc, 0.0)
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # logsumexp per row, the softmax residual the backward kernels re-derive
     # p from (FlashAttention-2's L); replicated across the lane dim so the
@@ -239,7 +245,9 @@ def _bwd_dq_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
             sij = jnp.where(q_pos + q_off >= k_pos + k_off, sij, _NEG)
         if masked:
             sij = jnp.where(k_pos < length, sij, _NEG)
-        p = jnp.exp(sij - lse)
+        # fully-masked rows carry lse ~= -1e30; exp(sij - lse) would
+        # overflow to inf there — such rows contribute no gradient
+        p = jnp.where(lse > 0.5 * _NEG, jnp.exp(sij - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -289,7 +297,9 @@ def _bwd_dkv_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
             sij = jnp.where(q_pos + q_off >= k_pos + k_off, sij, _NEG)
         if masked:
             sij = jnp.where(k_pos < length, sij, _NEG)
-        p = jnp.exp(sij - lse)                 # [block_q, block_k]
+        # guard fully-masked rows (lse ~= -1e30) as in the dQ kernel
+        p = jnp.where(lse > 0.5 * _NEG, jnp.exp(sij - lse),
+                      0.0)                     # [block_q, block_k]
         if rate > 0.0:
             keep = _keep_mask(seed, b, q_pos, k_pos, t_k, rate)
             inv = 1.0 / (1.0 - rate)
@@ -424,24 +434,17 @@ def _xla_scores(q, k, causal, scale, seq_lens):
     return s
 
 
-def _xla_attention_lse(q, k, v, causal, scale, seq_lens=None):
+def _xla_attention_lse(q, k, v, causal, scale, seq_lens=None, rate=0.0,
+                       rng_key=None):
     """(out, lse) in plain XLA — the differentiable fallback matching
-    ``flash_attention_lse``'s two outputs (used by the PADDLE_TPU_FLASH_BWD
-    escape hatch so an lse cotangent is never dropped)."""
+    ``flash_attention_lse``'s two outputs (the PADDLE_TPU_FLASH_BWD
+    escape hatch and the op lowering's non-TPU branch, which must bind
+    the program's Lse output). With dropout it draws its own jax.random
+    mask — statistically, not bitwise, equivalent to the kernel's hash
+    RNG; the lse is of the pre-dropout softmax, as in the kernel."""
     s = _xla_scores(q, k, causal, scale, seq_lens)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     w = jnp.exp(s - lse[..., None])
-    out = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32))
-    return out.astype(q.dtype), lse
-
-
-def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
-                   rng_key=None):
-    """Unfused reference composition (and the off-TPU fallback). With
-    dropout it draws its own jax.random mask — statistically, not
-    bitwise, equivalent to the kernel's hash RNG."""
-    s = _xla_scores(q, k, causal, scale, seq_lens)
-    w = jax.nn.softmax(s, axis=-1)
     if rate > 0.0:
         from paddle_tpu.ops.common import hash_keep_mask
 
@@ -449,8 +452,15 @@ def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
             rng_key = jax.random.PRNGKey(0)
         keep = hash_keep_mask(rng_key, w.shape, rate)
         w = jnp.where(keep, w / (1.0 - rate), 0.0)
-    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(
-        q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
+
+
+def _xla_attention(q, k, v, causal, scale, seq_lens=None, rate=0.0,
+                   rng_key=None):
+    """Unfused reference composition (and the off-TPU fallback)."""
+    return _xla_attention_lse(q, k, v, causal, scale, seq_lens, rate,
+                              rng_key)[0]
 
 
 def _check_tileable(q, k, block_q, block_k):
@@ -517,11 +527,12 @@ def flash_attention_lse(q, k, v, seq_lens=None, offsets=None, seed=0,
     traced — [q_off, k_off]) places the Q and K blocks at global sequence
     positions so causal masking works across ring steps, and the exposed
     lse lets the caller merge per-step partial outputs with the standard
-    logaddexp rescaling. A Q tile entirely before the K range contributes
-    zero rows with lse ~= -1e30, which the merge maps to weight 0. The
-    lse cotangent is folded into the backward kernels' delta (see
-    ``_flash_backward``), so differentiating through the merge costs no
-    extra kernel.
+    logaddexp rescaling. Offsets need not be block-aligned: any row whose
+    every key lands ahead of the causal frontier publishes out = 0 with
+    lse ~= -1e30 (the kernels guard the fully-masked-row case), which the
+    merge maps to weight 0. The lse cotangent is folded into the backward
+    kernels' delta (see ``_flash_backward``), so differentiating through
+    the merge costs no extra kernel.
 
     ``seq_lens`` ([B] int) masks keys at positions >= len (padding mask);
     lengths are clamped to >= 1, so a fully-empty sequence attends to key
@@ -624,6 +635,30 @@ def flash_dispatch_ok(tq, tk):
             and tk >= _flash_min_seq())
 
 
+def dispatch_attention_lse(q, k, v, causal=False, scale=None, seq_lens=None,
+                           dropout_rate=0.0, seed=0, force_pallas=None):
+    """THE shared (out, lse) attention dispatch: the Pallas kernels when
+    ``flash_dispatch_ok`` (block table + interpret flag resolved here, in
+    exactly one place), the XLA composition otherwise. ``fused_attention``,
+    the fused_attention op lowering, and the registered grad op's
+    recompute fallback all route through this function, so the forward a
+    gradient differentiates can never silently diverge from the forward
+    that produced the saved Out."""
+    Tq, Tk = q.shape[2], k.shape[2]
+    use_pallas = (force_pallas if force_pallas is not None
+                  else flash_dispatch_ok(Tq, Tk))
+    if use_pallas:
+        return flash_attention_lse(q, k, v, seq_lens, None, seed, causal,
+                                   scale, dropout_rate,
+                                   pick_block(Tq, q.dtype),
+                                   pick_block(Tk, q.dtype),
+                                   not _on_tpu())
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    key = jax.random.PRNGKey(seed) if dropout_rate > 0.0 else None
+    return _xla_attention_lse(q, k, v, causal, scale_, seq_lens,
+                              dropout_rate, key)
+
+
 def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
                     dropout_rate=0.0, seed=0, force_pallas=None):
     """Dispatch point for whole-attention fusion: the Pallas flash kernels
@@ -639,16 +674,5 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
     what makes long-context training fit at all. ``seq_lens`` lengths are
     clamped to >= 1 (see flash_attention). ``force_pallas=True`` runs the
     kernel in interpreter mode off-TPU (tests)."""
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    Tq, Tk = q.shape[2], k.shape[2]
-    use_pallas = (force_pallas if force_pallas is not None
-                  else flash_dispatch_ok(Tq, Tk))
-    if use_pallas:
-        return flash_attention(q, k, v, seq_lens, seed, causal, scale,
-                               dropout_rate,
-                               block_q=pick_block(Tq, q.dtype),
-                               block_k=pick_block(Tk, q.dtype),
-                               interpret=not _on_tpu())
-    key = jax.random.PRNGKey(seed) if dropout_rate > 0.0 else None
-    return _xla_attention(q, k, v, causal, scale, seq_lens, dropout_rate,
-                          key)
+    return dispatch_attention_lse(q, k, v, causal, scale, seq_lens,
+                                  dropout_rate, seed, force_pallas)[0]

@@ -2412,6 +2412,7 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(dtype=q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
+    outputs = {"Out": [out]}
     if seq_lens is not None:
         inputs["SeqLens"] = [seq_lens]
     attrs = {"causal": bool(causal), "dropout_rate": float(dropout_rate)}
@@ -2423,10 +2424,16 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
         attrs["sp_axis"] = sp_axis
         if sp_batch_axis:
             attrs["sp_batch_axis"] = sp_batch_axis
+    else:
+        # softmax residual (per-row logsumexp): saved so the registered
+        # fused_attention_grad can run the flash backward kernels from
+        # (Out, Lse) without re-executing the forward custom call
+        outputs["Lse"] = [
+            helper.create_variable_for_type_inference(dtype="float32")]
     if scale is not None:
         attrs["scale"] = float(scale)
     helper.append_op(type="fused_attention", inputs=inputs,
-                     outputs={"Out": [out]}, attrs=attrs)
+                     outputs=outputs, attrs=attrs)
     return out
 
 

@@ -307,16 +307,10 @@ def batch_norm_grad(ctx, ins, attrs):
             "Bias@GRAD": [dbias.astype(scale.dtype)]}
 
 
-@register_op("fused_attention", needs_rng=True, no_grad_inputs=("SeqLens",))
-def fused_attention_op(ctx, ins, attrs):
-    """Whole-attention fusion: Pallas flash kernel on TPU, XLA composition
-    elsewhere (inputs Q/K/V are [B, H, T, D]; optional SeqLens [B] masks
-    keys past each sequence's length — the TPU-native form of the
-    reference's additive [B, H, T, T] padding masks). ``dropout_rate``
-    is attention-weight dropout executed inside the kernel (counter-based
-    hash RNG, reproduced exactly by the backward kernels)."""
-    from paddle_tpu.kernels import fused_attention as _fa
-
+def _fused_attention_args(ctx, ins, attrs):
+    """Shared forward/backward argument resolution — the grad op MUST see
+    the same dtypes, mask, dropout seed (same per-op rng stream id), and
+    dispatch decision the forward saw."""
     q, k, v = amp_cast(single(ins, "Q"), single(ins, "K"), single(ins, "V"))
     lens = single(ins, "SeqLens") if ins.get("SeqLens") else None
     if lens is not None:
@@ -328,32 +322,118 @@ def fused_attention_op(ctx, ins, attrs):
         seed = jax.random.randint(ctx.rng(), (), 0, jnp.iinfo(jnp.int32).max)
     else:
         seed = 0
+    return q, k, v, lens, rate, seed
+
+
+def _ring_attention_from_attrs(q, k, v, attrs):
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    return ring_attention(
+        q, k, v, axis_name=str(attrs.get("sp_axis", "sp")),
+        causal=bool(attrs.get("causal", False)),
+        scale=attrs.get("scale", None),
+        batch_axis=attrs.get("sp_batch_axis", None) or None)
+
+
+def _check_ring_supported(rate, lens):
+    if rate > 0.0:
+        raise NotImplementedError(
+            "fused_attention: dropout inside the ring-attention path "
+            "is not supported; set dropout_rate=0 when "
+            "sequence_parallel=True")
+    if lens is not None:
+        raise NotImplementedError(
+            "fused_attention: seq_lens masks are not supported with "
+            "sequence_parallel=True (pad to full length instead)")
+
+
+@register_op("fused_attention", needs_rng=True, no_grad_inputs=("SeqLens",),
+             grad_needs_outputs=("Out", "Lse"))
+def fused_attention_op(ctx, ins, attrs):
+    """Whole-attention fusion: Pallas flash kernel on TPU, XLA composition
+    elsewhere (inputs Q/K/V are [B, H, T, D]; optional SeqLens [B] masks
+    keys past each sequence's length — the TPU-native form of the
+    reference's additive [B, H, T, T] padding masks). ``dropout_rate``
+    is attention-weight dropout executed inside the kernel (counter-based
+    hash RNG, reproduced exactly by the backward kernels).
+
+    The kernel path also emits the per-row logsumexp as ``Lse``: with
+    (Out, Lse) saved, the registered fused_attention_grad runs the
+    backward kernels DIRECTLY instead of differentiating a re-lowered
+    forward — the generic-vjp route re-executed the forward custom call
+    inside the backward (custom calls never CSE), which the round-5
+    seq-2048 trace measured at ~1.3 ms/layer/step of pure waste."""
+    from paddle_tpu.kernels.flash_attention import dispatch_attention_lse
+
+    q, k, v, lens, rate, seed = _fused_attention_args(ctx, ins, attrs)
     if bool(attrs.get("sequence_parallel", False)):
         # long-sequence path: exact attention with the T axis sharded over
         # the mesh's sp axis via ppermute ring (parallel/ring_attention.py)
         # — the framework-level entry to sequence/context parallelism
-        if rate > 0.0:
-            raise NotImplementedError(
-                "fused_attention: dropout inside the ring-attention path "
-                "is not supported; set dropout_rate=0 when "
-                "sequence_parallel=True")
-        if lens is not None:
-            raise NotImplementedError(
-                "fused_attention: seq_lens masks are not supported with "
-                "sequence_parallel=True (pad to full length instead)")
-        from paddle_tpu.parallel.ring_attention import ring_attention
+        _check_ring_supported(rate, lens)
+        return {"Out": [_ring_attention_from_attrs(q, k, v, attrs)]}
+    out, lse = dispatch_attention_lse(
+        q, k, v, bool(attrs.get("causal", False)),
+        attrs.get("scale", None), lens, rate, seed,
+        attrs.get("__force_flash__", None))  # tests: interpret-mode kernel
+    # the XLA branch's lse binds the program's Lse var too (the direct
+    # grad op ignores it there and XLA DCEs it when nothing reads it)
+    return {"Out": [out], "Lse": [lse]}
 
-        out = ring_attention(
-            q, k, v, axis_name=str(attrs.get("sp_axis", "sp")),
-            causal=bool(attrs.get("causal", False)),
-            scale=attrs.get("scale", None),
-            batch_axis=attrs.get("sp_batch_axis", None) or None)
-        return {"Out": [out]}
-    out = _fa(q, k, v,
-              causal=bool(attrs.get("causal", False)),
-              scale=attrs.get("scale", None),
-              seq_lens=lens, dropout_rate=rate, seed=seed)
-    return {"Out": [out]}
+
+@register_no_grad_op("fused_attention_grad", needs_rng=True)
+def fused_attention_grad_op(ctx, ins, attrs):
+    """Direct attention backward. When the forward took the Pallas path
+    and saved (Out, Lse), this calls the FlashAttention-2 backward
+    kernels with the saved softmax residuals — no forward re-execution.
+    Every other branch (ring, XLA composition, a program built without
+    the Lse output) differentiates the same forward dispatch inline,
+    which is exactly what the generic vjp route did."""
+    from paddle_tpu.kernels.flash_attention import (_LSE_LANES,
+                                                    _flash_backward,
+                                                    _on_tpu,
+                                                    dispatch_attention_lse,
+                                                    flash_dispatch_ok,
+                                                    pick_block)
+
+    q, k, v, lens, rate, seed = _fused_attention_args(ctx, ins, attrs)
+    causal = bool(attrs.get("causal", False))
+    scale = attrs.get("scale", None)
+    g = single(ins, "Out@GRAD")
+    g = jnp.asarray(g, q.dtype).reshape(q.shape)
+    if bool(attrs.get("sequence_parallel", False)):
+        _check_ring_supported(rate, lens)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _ring_attention_from_attrs(q_, k_, v_,
+                                                          attrs),
+            q, k, v)
+        dq, dk, dv = vjp(g)
+        return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}
+    Tq, Tk = q.shape[2], k.shape[2]
+    force = attrs.get("__force_flash__", None)
+    flash_ok = flash_dispatch_ok(Tq, Tk) if force is None else bool(force)
+    out = single(ins, "Out") if ins.get("Out") else None
+    lse = single(ins, "Lse") if ins.get("Lse") else None
+    if flash_ok and out is not None and lse is not None:
+        bq, bk = pick_block(Tq, q.dtype), pick_block(Tk, q.dtype)
+        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+        B, H, _, _ = q.shape
+        lse_k = jnp.broadcast_to(lse.reshape(B * H, Tq, 1),
+                                 (B * H, Tq, _LSE_LANES))  # kernel layout
+        dq, dk, dv = _flash_backward(
+            q, k, v, out.astype(q.dtype), lse_k, g, None, lens, None,
+            seed, causal, scale_, rate, min(bq, Tq), min(bk, Tk),
+            not _on_tpu())
+        return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}
+
+    # program lacks the saved residuals (old desc) or took the XLA branch:
+    # differentiate the SAME shared dispatch the forward ran
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dispatch_attention_lse(
+            q_, k_, v_, causal, scale, lens, rate, seed, force)[0],
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}
 
 
 @register_op("layer_norm")

@@ -212,6 +212,54 @@ class TestFusedAttentionOp:
                                    rtol=2e-4)
 
 
+class TestDirectFusedAttentionGrad:
+    """The registered fused_attention_grad: the Pallas path saves
+    (Out, Lse) and the grad op runs the backward kernels directly — no
+    forward re-execution (round-5 seq-2048 trace: the generic vjp
+    re-ran the forward custom call at ~1.3 ms/layer/step). Training
+    trajectories through the forced-kernel path must match the XLA
+    composition path."""
+
+    @staticmethod
+    def _train(force_flash, steps=3):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.framework import Program, program_guard
+
+        B, H, T, D = 1, 2, 32, 8
+        rng = np.random.RandomState(0)
+        init = {n: rng.randn(B, H, T, D).astype(np.float32) * 0.5
+                for n in ("pq", "pk", "pv")}
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            ps = [fluid.layers.create_parameter([B, H, T, D], "float32",
+                                                name=n)
+                  for n in ("pq", "pk", "pv")]
+            out = fluid.layers.nn.fused_attention(*ps, causal=True)
+            loss = fluid.layers.reduce_mean(out * out)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        if force_flash is not None:
+            for op in main.desc.global_block().ops:
+                if op.type.startswith("fused_attention"):
+                    op.attrs["__force_flash__"] = force_flash
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for n, v in init.items():
+                scope.set(n, v)
+            for _ in range(steps):
+                (l,) = exe.run(main, feed={}, fetch_list=[loss])
+                losses.append(float(np.asarray(l).reshape(-1)[0]))
+        return losses
+
+    def test_kernel_path_trains_identically_to_xla_path(self):
+        flash = self._train(True)   # interpret-mode Pallas + direct grad
+        xla = self._train(False)    # XLA composition + inline vjp
+        np.testing.assert_allclose(flash, xla, rtol=2e-4, atol=2e-5)
+        assert flash[-1] < flash[0]  # it genuinely optimizes
+
+
 class TestFlashBackwardKernel:
     """The Pallas dQ/dKdV kernels (FlashAttention-2 decomposition) vs XLA
     autodiff of the reference composition."""
@@ -338,6 +386,44 @@ class TestChunkedLse:
 
         gc = jax.grad(loss_chunked, argnums=(0, 1, 2))(q, k, v)
         gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gc, gf, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-3, err_msg=name)
+
+    def test_unaligned_chunks_match_full(self):
+        """Offsets need NOT be block-aligned: splitting K unevenly (8 +
+        24) makes rows 0..7 of the second call fully masked under causal
+        — the kernels' fully-masked-row guard must zero them (without it
+        p = exp(0) = 1 for every key and the merge is garbage), and the
+        backward must send them zero gradient."""
+        from paddle_tpu.kernels.flash_attention import flash_attention_lse
+
+        B, H, T, D = 1, 2, 32, 8
+        q, k, v = (jnp.asarray(_rand((B, H, T, D), s)) for s in (12, 13, 14))
+
+        def merged(q_, k_, v_):
+            o = jnp.zeros(q_.shape, jnp.float32)
+            lse = jnp.full(q_.shape[:3], -1e30, jnp.float32)
+            for lo, hi in ((0, 8), (8, 32)):
+                off = jnp.array([0, lo], jnp.int32)
+                o_j, lse_j = flash_attention_lse(
+                    q_, k_[:, :, lo:hi], v_[:, :, lo:hi], None, off, 0,
+                    True, None, 0.0, 16, 8, True)
+                lse_new = jnp.logaddexp(lse, lse_j)
+                o = (o * jnp.exp(lse - lse_new)[..., None]
+                     + o_j.astype(jnp.float32)
+                     * jnp.exp(lse_j - lse_new)[..., None])
+                lse = lse_new
+            return o.astype(q_.dtype)
+
+        got = merged(q, k, v)
+        want = _xla_attention(q, k, v, True, D ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-4)
+        gc = jax.grad(lambda a, b, c: jnp.sum(merged(a, b, c) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(lambda a, b, c: jnp.sum(_xla_attention(
+            a, b, c, True, D ** -0.5) ** 2), argnums=(0, 1, 2))(q, k, v)
         for a, b, name in zip(gc, gf, ("dq", "dk", "dv")):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-3, err_msg=name)
