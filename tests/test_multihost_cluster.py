@@ -1,23 +1,35 @@
-"""Real multi-process SPMD cluster test (VERDICT r3 Next #4; reference:
-tests/unittests/test_dist_base.py:438 _run_cluster_nccl2 — the reference
-proves its collective mode with real multi-process clusters, bootstrap
-gen_nccl_id_op.cc; here the bootstrap is jax.distributed via
-parallel/env.py and the launcher is distributed/launch.py).
+"""Real multi-process SPMD cluster tests (VERDICT r3 Next #4, r4 Next #6;
+reference: tests/unittests/test_dist_base.py:438 _run_cluster_nccl2 +
+parallel_executor_test_base.py's trajectory discipline — the reference
+proves its collective mode with real multi-process clusters and compares
+whole loss trajectories, not a step or two; bootstrap gen_nccl_id_op.cc;
+here the bootstrap is jax.distributed via parallel/env.py and the
+launcher is distributed/launch.py).
 
 Two subprocesses x 4 virtual CPU devices each join a coordinator, build
-the GLOBAL 8-device dp×tp mesh, and train the graft-entry BERT step;
-losses must agree across ranks and with the same model trained in ONE
-process on its own 8-device mesh."""
+the GLOBAL 8-device dp×tp mesh, and train the graft-entry BERT step for
+50 steps with a mid-run async distributed checkpoint; losses must agree
+across ranks and track the same model trained in ONE process on its own
+8-device mesh for the whole trajectory. A SECOND fresh cluster then
+restores the mid-run checkpoint and must continue the original
+trajectory — the end-to-end consumer of checkpoint.py's multi-host
+layout (per-process dirs, slice ownership)."""
 
 import json
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
 
 import numpy as np
+import pytest
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+N_STEPS = 50
+SAVE_STEP = 25
 
 
 def _free_port():
@@ -28,7 +40,40 @@ def _free_port():
     return port
 
 
-def _single_process_losses():
+def _launch_cluster(env_extra, timeout=600):
+    """Run the 2-process worker cluster to completion; returns
+    {rank: losses}."""
+    from paddle_tpu.distributed.launch import launch_processes
+
+    worker = os.path.join(REPO, "tests", "spmd_cluster_worker.py")
+    env = dict(env_extra)
+    for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
+        env[var] = ""   # the worker sets its own platform config
+    procs = launch_processes([worker], nproc=2, started_port=_free_port(),
+                             env_extra=env, capture_output=True)
+    outs, errs = [], []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+        errs.append(err)
+    assert all(p.returncode == 0 for p in procs), (
+        [e.decode()[-2000:] for e in errs])
+    results = {}
+    for out in outs:
+        for line in out.decode().splitlines():
+            if line.startswith("CLUSTER_RESULT "):
+                r = json.loads(line[len("CLUSTER_RESULT "):])
+                results[r["rank"]] = r["losses"]
+    assert sorted(results) == [0, 1], (results, outs, errs)
+    return results
+
+
+def _single_process_losses(n_steps):
     import paddle_tpu.fluid as fluid
     import __graft_entry__ as graft
 
@@ -38,84 +83,73 @@ def _single_process_losses():
     losses = []
     with fluid.scope_guard(scope):
         exe.run(startup)
-        for _ in range(4):
+        for _ in range(n_steps):
             (loss,) = exe.run(compiled, feed=batch,
                               fetch_list=[h["loss"]])
             losses.append(float(np.asarray(loss).reshape(-1)[0]))
-        params = {p.name: np.asarray(scope.get(p.name))
-                  for p in main_prog.all_parameters()}
-    return losses, params
+    return losses
 
 
-def test_two_process_cluster_matches_single_process():
-    from paddle_tpu.distributed.launch import launch_processes
-
-    worker = os.path.join(REPO, "tests", "spmd_cluster_worker.py")
-    # the launcher's endpoint list doubles as the coordinator address
-    # (rank 0's endpoint), exactly as init_distributed consumes it
-    import tempfile
-
-    port = _free_port()
+@pytest.fixture(scope="module")
+def cluster_run():
+    """One 50-step 2-process cluster run with the mid-run checkpoint,
+    shared by the trajectory test and the resume test (cluster launches
+    are the expensive part)."""
     ckpt_dir = tempfile.mkdtemp(prefix="cluster_ckpt_")
-    env_extra = {"CLUSTER_CKPT_DIR": ckpt_dir}
-    for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
-        env_extra[var] = ""   # the worker sets its own platform config
-    procs = launch_processes([worker], nproc=2, started_port=port,
-                             env_extra=env_extra, capture_output=True)
-    outs, errs = [], []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-        errs.append(err)
-    assert all(p.returncode == 0 for p in procs), (
-        [e.decode()[-2000:] for e in errs])
+    try:
+        results = _launch_cluster({
+            "CLUSTER_CKPT_DIR": ckpt_dir,
+            "CLUSTER_STEPS": str(N_STEPS),
+            "CLUSTER_SAVE_STEP": str(SAVE_STEP),
+        })
+        yield results, ckpt_dir
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
 
-    results = {}
-    for out in outs:
-        for line in out.decode().splitlines():
-            if line.startswith("CLUSTER_RESULT "):
-                r = json.loads(line[len("CLUSTER_RESULT "):])
-                results[r["rank"]] = r["losses"]
-    assert sorted(results) == [0, 1], (results, outs, errs)
-    # both ranks computed the SAME global step
+
+def test_two_process_50step_trajectory_matches_single_process(cluster_run):
+    results, _ = cluster_run
+    # both ranks computed the SAME global steps, the whole way
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+    assert len(results[0]) == N_STEPS
 
-    single, single_params = _single_process_losses()
-    # same math as one process over 8 local devices: parity within
-    # float-reassociation tolerance (cross-host collectives reassociate)
-    np.testing.assert_allclose(results[0], single, rtol=1e-4, atol=1e-5)
+    single = _single_process_losses(N_STEPS)
+    # same math as one process over 8 local devices: the TRAJECTORY stays
+    # within float-reassociation tolerance of the single-process run for
+    # all 50 steps (cross-host collectives reassociate float adds, and
+    # Adam compounds the rounding over steps — hence looser than the
+    # 4-step bound rounds 3-4 used)
+    np.testing.assert_allclose(results[0], single, rtol=5e-3, atol=1e-4)
     # and it genuinely trains
-    assert results[0][-1] < results[0][0]
+    assert np.mean(results[0][-5:]) < 0.5 * np.mean(results[0][:5])
 
-    # the distributed checkpoint written by BOTH processes (each its own
-    # proc dir) restores to the full global params — compared against
-    # the single-process run, which computed the same 4 steps
-    import json as _json
-    import shutil
+
+def test_fresh_cluster_resumes_checkpoint_and_continues_trajectory(
+        cluster_run):
+    """A brand-new 2-process cluster restores the mid-run distributed
+    checkpoint (every process reads the merged per-process manifests)
+    and continues training; its losses must reproduce the original
+    cluster's post-checkpoint trajectory — which proves the checkpoint
+    captured ALL persistable state (params + Adam moments + beta powers)
+    across both processes' shard dirs."""
+    results, ckpt_dir = cluster_run
 
     from paddle_tpu.checkpoint import CheckpointManager
 
-    try:
-        mgr = CheckpointManager(ckpt_dir, process_count=1)
-        assert mgr.all_steps() == [4], os.listdir(ckpt_dir)
-        data = mgr.restore(4)
-        r0 = _json.loads([l for l in outs[0].decode().splitlines()
-                          if l.startswith("CLUSTER_RESULT ")][0][15:])
-        # worker and parent builds produce the same param-name sequence
-        # (each a fresh unique_name space); align positionally
-        single_names = list(single_params)
-        for wname, sname in zip(r0["param_names"], single_names):
-            got = data[wname]
-            want = single_params[sname]
-            assert got.shape == want.shape, (wname, sname)
-            np.testing.assert_allclose(
-                got, want, rtol=1e-3, atol=1e-4,
-                err_msg="restored %s != single-process %s"
-                        % (wname, sname))
-    finally:
-        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    # the mid-run async save published exactly one complete step, from
+    # BOTH processes (two .procN dirs merged by the reader)
+    mgr = CheckpointManager(ckpt_dir, process_index=0, process_count=1)
+    assert mgr.all_steps() == [SAVE_STEP], os.listdir(ckpt_dir)
+
+    resumed = _launch_cluster({
+        "CLUSTER_CKPT_DIR": ckpt_dir,
+        "CLUSTER_STEPS": str(N_STEPS),
+        "CLUSTER_RESUME_STEP": str(SAVE_STEP),
+    })
+    np.testing.assert_allclose(resumed[0], resumed[1], rtol=1e-6)
+    assert len(resumed[0]) == N_STEPS - SAVE_STEP
+    # restore-then-train continues the original run: fp32 state round-
+    # trips through .npy exactly, so the only drift is execution
+    # nondeterminism, far tighter than cross-topology tolerance
+    np.testing.assert_allclose(resumed[0], results[0][SAVE_STEP:],
+                               rtol=1e-4, atol=1e-6)
