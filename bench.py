@@ -150,6 +150,138 @@ def bench_bert_long(batch=4, seq_len=2048, steps=12, warmup=3):
                            seq_len=seq_len)
 
 
+def _pipelined_throughput(main, startup, h_loss, feed_vars, reader_fn,
+                          batch, steps, warmup, transforms=None):
+    """Train THROUGH the host->device input pipeline: a producer thread
+    pushes host batches into the native blocking queue (PyReader), the
+    step loop stages batch i+1 onto the device (async device_put) while
+    step i computes — the reference's double-buffered reader discipline
+    (operators/reader/buffered_reader.cc:15: one buffer transfers while
+    the previous computes) instead of bench-side pre-staged arrays.
+    ``transforms`` maps feed names to on-device jitted post-transfer
+    functions (e.g. uint8 -> normalized float32, the wire-width fix)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.layers.io import PyReader
+
+    reader = PyReader(feed_vars, capacity=4)
+    reader.decorate_paddle_reader(reader_fn)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    transforms = transforms or {}
+
+    def stage(d):
+        out = {}
+        for k, v in d.items():
+            v = jax.device_put(v)
+            if k in transforms:
+                v = transforms[k](v)
+            out[k] = v
+        return out
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        reader.start()
+        cur = stage(reader.next_feed())
+        out = None
+        for _ in range(warmup):
+            nxt = stage(reader.next_feed())   # H2D overlaps the step below
+            out = exe.run(main, feed=cur, fetch_list=[h_loss],
+                          return_numpy=False)[0]
+            cur = nxt
+        jax.device_get(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            nxt = stage(reader.next_feed())
+            out = exe.run(main, feed=cur, fetch_list=[h_loss],
+                          return_numpy=False)[0]
+            cur = nxt
+        val = jax.device_get(out)
+        elapsed = time.perf_counter() - t0
+    assert np.isfinite(float(np.asarray(val).reshape(-1)[0]))
+    return batch * steps / elapsed
+
+
+def bench_resnet50_pipelined(batch=None, steps=None, warmup=2,
+                             wire_dtype="float32"):
+    """ResNet-50 fed from HOST memory through PyReader + device staging
+    (VERDICT r4 Next #2). ``wire_dtype="float32"`` moves images at full
+    width, the traffic the reference's reader chain moves (~300 MB/batch
+    at 512); ``"uint8"`` is the wire-width fix — raw bytes over the link,
+    normalization on device (4x less transfer). On the TUNNELED bench
+    chip either is link-bound (~24 MB/s effective H2D measured round 5 —
+    the tunnel, not the pipeline: BERT's KB-scale feeds pipeline at ~2%
+    overhead), so steps default low to bound driver bench runtime; on a
+    co-located host (the deployment scenario, PCIe-class link) the same
+    path hides a 308 MB batch under the 213 ms step."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch = batch or (512 if on_tpu else 4)
+    steps = steps or (6 if on_tpu else 3)
+    main, startup, h = models.resnet.get_model(
+        dataset="imagenet", depth=50, class_num=1000, lr=0.1)
+    if os.environ.get("PADDLE_TPU_AMP", "1") != "0":
+        fluid.contrib.mixed_precision.enable_bf16(main)
+    rng = np.random.RandomState(0)
+    # rotating pool of distinct host buffers: every step moves a real
+    # fresh batch over the link without holding `steps` batches in RAM
+    img_wire = h["img"]
+    if wire_dtype == "uint8":
+        imgs = [rng.randint(0, 256, (batch, 3, 224, 224)).astype(np.uint8)
+                for _ in range(3)]
+        transforms = {h["img"].name: jax.jit(
+            lambda u: u.astype(jnp.float32) / 127.5 - 1.0)}
+
+        class _WireVar:  # img var with the WIRE dtype (bytes over the
+            name = h["img"].name  # link; PyReader casts to var dtype)
+            dtype = "uint8"
+
+        img_wire = _WireVar()
+    else:
+        imgs = [rng.randn(batch, 3, 224, 224).astype(np.float32)
+                for _ in range(3)]
+        transforms = None
+    pool = [(im, rng.randint(0, 1000, (batch, 1)).astype(np.int64))
+            for im in imgs]
+    total = warmup + steps + 2
+    return _pipelined_throughput(
+        main, startup, h["loss"], [img_wire, h["label"]],
+        lambda: (pool[i % len(pool)] for i in range(total)),
+        batch, steps, warmup, transforms=transforms)
+
+
+def bench_bert_pipelined(batch=None, steps=30, warmup=4, seq_len=128):
+    """BERT-base fed through the same pipeline (token ids are ~KB-scale,
+    so this isolates the per-step pipeline overhead from bandwidth)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch = batch or (64 if on_tpu else 2)
+    if not on_tpu:
+        kwargs = dict(d_model=128, n_layers=2, n_heads=2, d_inner=256)
+    else:
+        kwargs = dict(d_model=768, n_layers=12, n_heads=12, d_inner=3072)
+    main, startup, h = models.bert.get_model(
+        batch_size=batch, seq_len=seq_len, vocab_size=30522, dropout=0.1,
+        lr=1e-4, max_position=max(512, seq_len), **kwargs)
+    if os.environ.get("PADDLE_TPU_AMP", "1") != "0":
+        fluid.contrib.mixed_precision.enable_bf16(main)
+    b = models.bert.make_fake_batch(batch, seq_len, 30522,
+                                    kwargs["n_heads"])
+    feeds = h["feeds"]
+    names = sorted(b)
+    total = warmup + steps + 2
+    return _pipelined_throughput(
+        main, startup, h["loss"], [feeds[n] for n in names],
+        lambda: (tuple(b[n] for n in names) for _ in range(total)),
+        batch, steps, warmup)
+
+
 def bench_transformer_nmt(batch=None, steps=20, warmup=4, seq_len=256):
     """Transformer NMT (encoder-decoder, label-smoothed CE) — BASELINE.md
     north-star config #4 (reference benchmark model:
@@ -344,6 +476,13 @@ def main():
         v = _try("resnet50", bench_resnet50)
         if v:
             result["value"] = v
+        v = _try("resnet50_pipelined", bench_resnet50_pipelined)
+        if v:
+            result["resnet50_pipelined_images_per_sec"] = v
+        v = _try("resnet50_pipelined_u8",
+                 lambda: bench_resnet50_pipelined(wire_dtype="uint8"))
+        if v:
+            result["resnet50_pipelined_u8_images_per_sec"] = v
     if which in ("default", "all", "bert"):
         v = _try("bert", bench_bert_base)
         if v:
@@ -351,6 +490,9 @@ def main():
         v = _try("bert_long", bench_bert_long)
         if v:
             result["bert_seq2048_samples_per_sec"] = v
+        v = _try("bert_pipelined", bench_bert_pipelined)
+        if v:
+            result["bert_pipelined_samples_per_sec"] = v
     if which in ("default", "all", "transformer"):
         v = _try("transformer", bench_transformer_nmt)
         if v:

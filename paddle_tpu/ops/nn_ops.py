@@ -394,7 +394,8 @@ def fused_attention_grad_op(ctx, ins, attrs):
                                                     _on_tpu,
                                                     dispatch_attention_lse,
                                                     flash_dispatch_ok,
-                                                    pick_block)
+                                                    pick_block,
+                                                    pick_bwd_blocks)
 
     q, k, v, lens, rate, seed = _fused_attention_args(ctx, ins, attrs)
     causal = bool(attrs.get("causal", False))
@@ -420,10 +421,12 @@ def fused_attention_grad_op(ctx, ins, attrs):
         B, H, _, _ = q.shape
         lse_k = jnp.broadcast_to(lse.reshape(B * H, Tq, 1),
                                  (B * H, Tq, _LSE_LANES))  # kernel layout
+        dq_blocks, dkv_blocks = pick_bwd_blocks(
+            Tq, Tk, q.dtype, (min(bq, Tq), min(bk, Tk)))
         dq, dk, dv = _flash_backward(
             q, k, v, out.astype(q.dtype), lse_k, g, None, lens, None,
             seed, causal, scale_, rate, min(bq, Tq), min(bk, Tk),
-            not _on_tpu())
+            not _on_tpu(), dq_blocks=dq_blocks, dkv_blocks=dkv_blocks)
         return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}
 
     # program lacks the saved residuals (old desc) or took the XLA branch:
