@@ -207,6 +207,45 @@ class TestProfiler:
             x = np.ones(4).sum()
         assert x == 4
 
+    def test_chrome_trace_timeline_export(self, tmp_path):
+        """tools/timeline.py converts a jax profiler xplane dump into
+        chrome://tracing JSON (capability parity with the reference
+        repo's tools/timeline.py — same workflow: profile, convert,
+        open in the trace viewer)."""
+        import json
+        import os
+
+        os.environ.setdefault(
+            "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+        try:
+            from tensorflow.tsl.profiler.protobuf import (  # noqa: F401
+                xplane_pb2)
+        except Exception as e:  # pragma: no cover
+            import pytest
+
+            pytest.skip("xplane proto unavailable: %s" % e)
+        import jax
+        import jax.numpy as jnp
+
+        tdir = str(tmp_path / "trace")
+        jax.profiler.start_trace(tdir)
+        try:
+            jax.device_get(
+                jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+        finally:
+            jax.profiler.stop_trace()
+
+        from tools.timeline import xplane_to_chrome_trace
+
+        trace = xplane_to_chrome_trace(tdir)
+        evs = trace["traceEvents"]
+        slices = [e for e in evs if e.get("ph") == "X"]
+        assert slices, "no duration events exported"
+        assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in slices)
+        metas = {e["name"] for e in evs if e.get("ph") == "M"}
+        assert {"process_name", "thread_name"} <= metas
+        json.loads(json.dumps(trace))  # valid chrome-trace JSON
+
 
 def test_check_nan_inf_guard(monkeypatch):
     """PADDLE_TPU_CHECK_NAN_INF raises naming the poisoned tensor

@@ -14,21 +14,43 @@ import sys
 from collections import defaultdict
 
 
-def top_ops(trace_dir, top_n=25, group="op"):
+def iter_planes(trace_dir):
+    """Yield every non-empty DISTINCT plane from the .xplane.pb files
+    under ``trace_dir`` (shared by this tool and tools/timeline.py).
+    Byte-identical planes are skipped — some sessions embed the same
+    device plane in more than one dump file, which would double every
+    aggregate — while genuine multi-host planes (same name, different
+    events/timestamps) all pass through."""
+    import hashlib
+
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
-    files = glob.glob("%s/**/*.xplane.pb" % trace_dir, recursive=True)
-    assert files, "no xplane.pb under %s" % trace_dir
+    files = sorted(glob.glob("%s/**/*.xplane.pb" % trace_dir,
+                             recursive=True))
+    if not files:
+        raise FileNotFoundError("no xplane.pb under %s" % trace_dir)
+    seen = set()
+    for f in files:
+        xs = xplane_pb2.XSpace()
+        with open(f, "rb") as fh:
+            xs.ParseFromString(fh.read())
+        for plane in xs.planes:
+            if not sum(len(l.events) for l in plane.lines):
+                continue
+            digest = hashlib.sha256(plane.SerializeToString()).digest()
+            if digest in seen:
+                continue
+            seen.add(digest)
+            yield plane
+
+
+def top_ops(trace_dir, top_n=25, group="op"):
     per = defaultdict(float)
     total = 0.0
     # aggregate over every host's trace file and every device plane
     # (multi-core chips emit one plane per core)
-    for f in files:
-        xs = xplane_pb2.XSpace()
-        xs.ParseFromString(open(f, "rb").read())
-        planes = [p for p in xs.planes if "/device:" in p.name
-                  and sum(len(l.events) for l in p.lines)]
-        for plane in planes:
+    for plane in iter_planes(trace_dir):
+        if "/device:" in plane.name:
             meta = {m.id: m.name for m in plane.event_metadata.values()}
             for line in plane.lines:
                 if line.name != "XLA Ops":
