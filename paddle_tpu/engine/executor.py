@@ -103,48 +103,12 @@ class Engine:
         feed = feed or {}
         fetch_list = fetch_list or []
         block = program_desc.block(block_idx)
-
-        feed_items = sorted(feed.items())
-        feed_names = [k for k, _ in feed_items]
-        feed_values = []
-        for name, value in feed_items:
-            if isinstance(value, jax.Array):
-                # already device-resident (e.g. pre-staged by an input
-                # pipeline) — no host round-trip
-                feed_values.append(value)
-                continue
-            vd = block.find_var_recursive(name)
-            if vd is not None and vd.dtype is not None and not hasattr(value, "dtype"):
-                value = np.asarray(value, dtype=convert_dtype_to_np(vd.dtype))
-            else:
-                value = np.asarray(value)
-            feed_values.append(value)
-
-        key = (
-            program_desc.cached_fingerprint(),
-            block_idx,
-            tuple((n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_values)),
-            tuple(fetch_list),
-            is_test,
-            donate_state,
-            amp,
-            accumulate_steps,
-            cache_key_extra,
-        )
-
-        compiled = self._cache.get(key)
-        if compiled is None:
-            compiled = self._compile(
-                block, feed_names, fetch_list, is_test, donate_state,
-                mesh=mesh, feed_values=feed_values,
-                shard_rules=shard_rules, data_axes=data_axes, amp=amp,
-                accumulate_steps=accumulate_steps,
-            )
-            self._cache[key] = compiled
-            while len(self._cache) > self._cache_capacity:
-                self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(key)
+        feed_names, feed_values = self._coerce_feed(block, feed)
+        compiled = self.get_compiled(
+            program_desc, block_idx, feed_names, feed_values, fetch_list,
+            is_test, donate_state, amp, accumulate_steps,
+            cache_key_extra=cache_key_extra, mesh=mesh,
+            shard_rules=shard_rules, data_axes=data_axes)
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
@@ -201,6 +165,61 @@ class Engine:
             # list) — per-value np.asarray syncs serially
             return list(jax.device_get(list(fetches)))
         return list(fetches)
+
+    @staticmethod
+    def _coerce_feed(block, feed):
+        """-> (names, values) sorted by name, host values coerced to the
+        feed var's declared dtype; device-resident jax arrays pass
+        through untouched (pre-staged input pipelines)."""
+        feed_names, feed_values = [], []
+        for name, value in sorted(feed.items()):
+            feed_names.append(name)
+            if isinstance(value, jax.Array):
+                feed_values.append(value)
+                continue
+            vd = block.find_var_recursive(name)
+            if (vd is not None and vd.dtype is not None
+                    and not hasattr(value, "dtype")):
+                value = np.asarray(value, dtype=convert_dtype_to_np(vd.dtype))
+            else:
+                value = np.asarray(value)
+            feed_values.append(value)
+        return feed_names, feed_values
+
+    def get_compiled(self, program_desc, block_idx, feed_names, feed_values,
+                     fetch_list, is_test, donate_state, amp,
+                     accumulate_steps, cache_key_extra=None, mesh=None,
+                     shard_rules=None, data_axes=("dp",)):
+        """LRU-cached executable lookup/compile for one (program, feed
+        signature) — shared by ``run_block`` and the Executor's
+        ``cost_analysis`` so an analysis compiles exactly the executable
+        a subsequent run reuses (and vice versa)."""
+        key = (
+            program_desc.cached_fingerprint(),
+            block_idx,
+            tuple((n, v.shape, str(v.dtype))
+                  for n, v in zip(feed_names, feed_values)),
+            tuple(fetch_list),
+            is_test,
+            donate_state,
+            amp,
+            accumulate_steps,
+            cache_key_extra,
+        )
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(
+                program_desc.block(block_idx), feed_names, fetch_list,
+                is_test, donate_state, mesh=mesh, feed_values=feed_values,
+                shard_rules=shard_rules, data_axes=data_axes, amp=amp,
+                accumulate_steps=accumulate_steps,
+            )
+            self._cache[key] = compiled
+            while len(self._cache) > self._cache_capacity:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
+        return compiled
 
     @staticmethod
     def _state_value(scope, name):

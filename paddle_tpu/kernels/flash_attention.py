@@ -66,7 +66,13 @@ def _keep_mask(seed, b, q_pos, k_pos, t_k, rate):
     """Deterministic dropout keep-mask from the *global* (b, q, k)
     coordinate: murmur3 finalizer bits -> uniform [0,1) -> >= rate.
     Counter-based, so the dQ and dK/dV kernels reproduce the forward's
-    mask exactly regardless of their different iteration orders."""
+    mask exactly regardless of their different iteration orders.
+
+    (Round-5 measured the in-kernel dropout at ~25% of whole-kernel time
+    and tried a strip-hoisted 1-multiply variant of this hash: the
+    overhead did NOT move — the cost is the unavoidable extra
+    compare/select/scale vector ops on the [bq, bk] tile, not the hash
+    arithmetic — so the stronger full-avalanche form stays.)"""
     from paddle_tpu.ops.common import hash_mix_bits, keep_threshold
 
     idx = (q_pos * t_k + k_pos).astype(jnp.uint32)

@@ -202,6 +202,30 @@ class TestLauncher:
 
 
 class TestProfiler:
+    def test_executor_cost_analysis(self):
+        """Executor.cost_analysis returns XLA's bytes-accessed/flops and
+        memory stats for the compiled step WITHOUT executing it (the
+        roofline workflow of MFU_r05.md as a first-class API)."""
+        from paddle_tpu import models
+
+        main, startup, h = models.mnist.get_model(lr=0.01)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        feed = {"img": np.zeros((8, 784), np.float32),
+                "label": np.zeros((8, 1), np.int64)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            w0 = np.asarray(scope.get(main.all_parameters()[0].name))
+            out = exe.cost_analysis(main, feed=feed,
+                                    fetch_list=[h["loss"]])
+            # analysis must not have run the step (no state mutation)
+            w1 = np.asarray(scope.get(main.all_parameters()[0].name))
+        np.testing.assert_array_equal(w0, w1)
+        assert out["flops"] and out["flops"] > 0
+        assert out["bytes_accessed"] and out["bytes_accessed"] > 0
+        assert out["memory"] is not None
+        assert out["memory"].argument_size_in_bytes > 0
+
     def test_record_event_span(self):
         with fluid.profiler.record_event("unit-test-span"):
             x = np.ones(4).sum()

@@ -37,7 +37,8 @@ def iter_planes(trace_dir):
         for plane in xs.planes:
             if not sum(len(l.events) for l in plane.lines):
                 continue
-            digest = hashlib.sha256(plane.SerializeToString()).digest()
+            digest = hashlib.sha256(
+                plane.SerializeToString(deterministic=True)).digest()
             if digest in seen:
                 continue
             seen.add(digest)
