@@ -695,27 +695,48 @@ def flash_dispatch_ok(tq, tk):
 
 
 def dispatch_attention_lse(q, k, v, causal=False, scale=None, seq_lens=None,
-                           dropout_rate=0.0, seed=0, force_pallas=None):
+                           dropout_rate=0.0, seed=0, force_pallas=None,
+                           raw_lse=False):
     """THE shared (out, lse) attention dispatch: the Pallas kernels when
     ``flash_dispatch_ok`` (block table + interpret flag resolved here, in
     exactly one place), the XLA composition otherwise. ``fused_attention``,
     the fused_attention op lowering, and the registered grad op's
     recompute fallback all route through this function, so the forward a
     gradient differentiates can never silently diverge from the forward
-    that produced the saved Out."""
+    that produced the saved Out.
+
+    ``raw_lse=True`` returns the logsumexp in the kernel's native tiling
+    carried as ``[B, H, Tq, _LSE_LANES]`` float32 (a major-dim-only
+    reshape of the kernel's [B*H, Tq, LANES] — layout-preserving, and
+    the leading dim keeps the build-time batch sentinel intact) instead
+    of the public ``[B, H, Tq]``. The fused_attention op saves it this
+    way so the backward kernels read it with zero relayout (the
+    [B,H,T] <-> [B*H,T,1] round trip doesn't commute with TPU tiling;
+    the round-5 seq-2048 trace showed 12 x ~0.08 ms/step of lse layout
+    copies). Only meaningful on the forward-only (op) path — the
+    custom_vjp keeps the public form."""
     Tq, Tk = q.shape[2], k.shape[2]
+    B, H = q.shape[0], q.shape[1]
+    bq, bk = pick_block(Tq, q.dtype), pick_block(Tk, q.dtype)
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     use_pallas = (force_pallas if force_pallas is not None
                   else flash_dispatch_ok(Tq, Tk))
     if use_pallas:
+        if raw_lse:
+            _check_tileable(q, k, bq, bk)
+            out, lse = _flash_forward(
+                q, k, v, seq_lens, None, seed, causal, scale_,
+                dropout_rate, bq, bk, not _on_tpu())
+            return out, lse.reshape(B, H, Tq, -1)
         return flash_attention_lse(q, k, v, seq_lens, None, seed, causal,
-                                   scale, dropout_rate,
-                                   pick_block(Tq, q.dtype),
-                                   pick_block(Tk, q.dtype),
+                                   scale_, dropout_rate, bq, bk,
                                    not _on_tpu())
-    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     key = jax.random.PRNGKey(seed) if dropout_rate > 0.0 else None
-    return _xla_attention_lse(q, k, v, causal, scale_, seq_lens,
-                              dropout_rate, key)
+    out, lse = _xla_attention_lse(q, k, v, causal, scale_, seq_lens,
+                                  dropout_rate, key)
+    if raw_lse:
+        lse = jnp.broadcast_to(lse[..., None], (B, H, Tq, _LSE_LANES))
+    return out, lse
 
 
 def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,

@@ -375,7 +375,8 @@ def fused_attention_op(ctx, ins, attrs):
     out, lse = dispatch_attention_lse(
         q, k, v, bool(attrs.get("causal", False)),
         attrs.get("scale", None), lens, rate, seed,
-        attrs.get("__force_flash__", None))  # tests: interpret-mode kernel
+        attrs.get("__force_flash__", None),  # tests: interpret-mode kernel
+        raw_lse=True)  # kernel-native layout: zero-relayout backward read
     # the XLA branch's lse binds the program's Lse var too (the direct
     # grad op ignores it there and XLA DCEs it when nothing reads it)
     return {"Out": [out], "Lse": [lse]}
@@ -419,8 +420,13 @@ def fused_attention_grad_op(ctx, ins, attrs):
         bq, bk = pick_block(Tq, q.dtype), pick_block(Tk, q.dtype)
         scale_ = scale if scale is not None else q.shape[-1] ** -0.5
         B, H, _, _ = q.shape
-        lse_k = jnp.broadcast_to(lse.reshape(B * H, Tq, 1),
-                                 (B * H, Tq, _LSE_LANES))  # kernel layout
+        # the forward saved lse in the kernel's own [B*H, Tq, LANES]
+        # layout (raw_lse) — this reshape/slice is an identity there, no
+        # relayout; it also accepts the public [B, H, Tq] form from an
+        # older program desc
+        lse_k = jnp.broadcast_to(
+            jnp.asarray(lse, jnp.float32).reshape(B * H, Tq, -1)[..., :1],
+            (B * H, Tq, _LSE_LANES))
         dq_blocks, dkv_blocks = pick_bwd_blocks(
             Tq, Tk, q.dtype, (min(bq, Tq), min(bk, Tk)))
         dq, dk, dv = _flash_backward(
