@@ -17,17 +17,40 @@ def _unary(fn):
     return lower
 
 
-register_op("relu")(_unary(jax.nn.relu))
-register_op("sigmoid")(_unary(jax.nn.sigmoid))
+def _out_based(type, fwd, dfn):
+    """Activation whose backward is an analytic function of its OUTPUT
+    (reference: activation_op.h functors declaring ``FwdDeps() ==
+    kDepOut`` — relu/sigmoid/tanh/exp/sqrt...). The generic vjp path
+    saves the activation's INPUT instead, which pins the pre-activation
+    tensor (e.g. the BN output feeding every ResNet relu) as a second
+    materialized [B, C, H, W] buffer from forward to backward; on a
+    bandwidth-bound conv net that is pure HBM traffic. The direct grad
+    references only ``Out`` — already materialized as the next op's
+    input — so the pre-activation dies inside the forward fusion."""
+    register_op(type, grad_needs_outputs=("Out",))(_unary(fwd))
+
+    def lower(ctx, ins, attrs):
+        out = single(ins, "Out")
+        if out is None:  # hand-built grad program without the Out wiring
+            out = fwd(single(ins, "X"))
+        g = single(ins, "Out@GRAD")
+        return {"X@GRAD": [dfn(out, g.astype(out.dtype)).astype(out.dtype)]}
+
+    register_no_grad_op(type + "_grad")(lower)
+
+
+_out_based("relu", jax.nn.relu, lambda out, g: g * (out > 0).astype(g.dtype))
+_out_based("sigmoid", jax.nn.sigmoid, lambda out, g: g * out * (1.0 - out))
+_out_based("tanh", jnp.tanh, lambda out, g: g * (1.0 - out * out))
+_out_based("exp", jnp.exp, lambda out, g: g * out)
+_out_based("sqrt", jnp.sqrt, lambda out, g: g * 0.5 / out)
+_out_based("rsqrt", lambda x: 1.0 / jnp.sqrt(x),
+           lambda out, g: g * (-0.5) * out * out * out)
+_out_based("reciprocal", lambda x: 1.0 / x, lambda out, g: -g * out * out)
 register_op("logsigmoid")(_unary(jax.nn.log_sigmoid))
-register_op("tanh")(_unary(jnp.tanh))
-register_op("exp")(_unary(jnp.exp))
 register_op("log")(_unary(jnp.log))
-register_op("sqrt")(_unary(jnp.sqrt))
-register_op("rsqrt")(_unary(lambda x: 1.0 / jnp.sqrt(x)))
 register_op("square")(_unary(jnp.square))
 register_op("abs")(_unary(jnp.abs))
-register_op("reciprocal")(_unary(lambda x: 1.0 / x))
 register_op("softsign")(_unary(lambda x: x / (1.0 + jnp.abs(x))))
 register_op("softplus")(_unary(jax.nn.softplus))
 register_op("tanh_shrink")(_unary(lambda x: x - jnp.tanh(x)))
