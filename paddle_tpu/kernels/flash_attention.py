@@ -273,32 +273,45 @@ def _bwd_dq_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
 
 
 def _bwd_dkv_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
-                    lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, block_k,
-                    causal, scale, rate, masked):
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    block_q, block_k, causal, scale, rate, masked):
+    """dK/dV with the Q dimension STREAMED over the innermost grid axis
+    (grid = (B*H, Tk/block_k, Tq/block_q)) and f32 accumulation in VMEM
+    scratch — the earlier form held full-length Q/dO/lse/delta resident
+    per program, so its VMEM footprint grew linearly with Tq and capped
+    trainable context at ~2-4k tokens (seq-4096+dropout exceeded the 16MB
+    scoped limit by 672KB; seq-8192 by 8.75MB). TPU grids iterate
+    sequentially, so the accumulator pattern (zero at j==0, emit at
+    j==nq-1) is the standard one — cf. the public pallas flash kernel's
+    block_q_major streaming (jax.experimental.pallas.ops.tpu)."""
     b = pl.program_id(0)
     s_idx = pl.program_id(1)
-    k_blk = k_ref[0]                           # [block_k, D]
-    v_blk = v_ref[0]                           # [block_k, D]
-    t_q = q_ref.shape[1]
+    j = pl.program_id(2)
+    nq = pl.num_programs(2)
     t_k = dk_ref.shape[1] * pl.num_programs(1)
-    nq = t_q // block_q
     length = len_ref[b]
     seed = seed_ref[0]
     q_off, k_off = off_ref[0], off_ref[1]
-    k_pos = s_idx * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
 
-    def body(j, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(j * block_q, block_q), :]
-        do = do_ref[0, pl.ds(j * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(j * block_q, block_q), :][:, :1]
-        delta = delta_ref[0, pl.ds(j * block_q, block_q), :][:, :1]
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        k_blk = k_ref[0]                       # [block_k, D]
+        v_blk = v_ref[0]                       # [block_k, D]
+        q = q_ref[0]                           # [block_q, D]
+        do = do_ref[0]                         # [block_q, D]
+        lse = lse_ref[0][:, :1]                # [block_q, 1]
+        delta = delta_ref[0][:, :1]            # [block_q, 1]
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         q_pos = j * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
+        k_pos = s_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             sij = jnp.where(q_pos + q_off >= k_pos + k_off, sij, _NEG)
         if masked:
@@ -313,7 +326,7 @@ def _bwd_dkv_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
         else:
             keep = None
             p_drop = p
-        dv = dv + jax.lax.dot_general(
+        dv_acc[...] += jax.lax.dot_general(
             p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
@@ -322,23 +335,23 @@ def _bwd_dkv_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
         if keep is not None:
             dp = jnp.where(keep, dp, 0.0) * inv
         ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
     if causal:
-        # q blocks strictly before this k block's first global row see
-        # none of it; with offsets the frontier can also put the whole Q
-        # range before the K block (j0 clamps to nq -> empty loop)
-        j0 = jnp.clip((k_off + s_idx * block_k - q_off) // block_q, 0, nq)
+        # q blocks whose last global row is before this k block's first
+        # see none of it — same frontier as the old fori j0, now a
+        # skipped grid step
+        pl.when((j + 1) * block_q - 1 + q_off
+                >= s_idx * block_k + k_off)(compute)
     else:
-        j0 = 0
-    dk0 = jnp.zeros((block_k, k_ref.shape[2]), jnp.float32)
-    dv0 = jnp.zeros((block_k, v_ref.shape[2]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(j0, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        compute()
+
+    @pl.when(j == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, g, g_lse, seq_lens, offsets, seed,
@@ -400,6 +413,32 @@ def _flash_backward(q, k, v, out, lse, g, g_lse, seq_lens, offsets, seed,
         interpret=interpret,
     )(lens, seed_arr, off_arr, qr, kr, vr, do, lse, delta)
 
+    # q/do/lse/delta stream over the innermost grid axis (VMEM bounded by
+    # the block size, not Tq — what makes seq >= 4096 compile). Causal
+    # runs skip the sub-frontier steps in-kernel; when the offsets are
+    # static zeros (every non-ring call) the fetch index also clamps to
+    # the frontier so skipped steps re-fetch the block the first live
+    # step needs (consecutive equal indices elide the copy). Ring-step
+    # (traced) offsets keep the identity map — fetches for skipped steps
+    # are wasted bandwidth but never wrong.
+    if causal and offsets is None:
+        nq_kv = Tq // bq_kv
+
+        def _qmap(b, s, j):
+            # lower-clamp to the causal frontier, upper-clamp to the last
+            # real Q block (Tk > Tq puts whole k blocks past every q —
+            # the body is skipped there, but the fetch must stay in range)
+            return (b, jnp.minimum(jnp.maximum(j, (s * bk_kv) // bq_kv),
+                                   nq_kv - 1), 0)
+    else:
+        def _qmap(b, s, j):
+            return (b, j, 0)
+    if not _HAS_PLTPU:
+        raise RuntimeError(
+            "flash backward needs pallas-TPU scratch support (pltpu "
+            "unimportable here); set PADDLE_TPU_FLASH_BWD=xla instead")
+    scratch = [pltpu.VMEM((bk_kv, D), jnp.float32),
+               pltpu.VMEM((bk_kv, D), jnp.float32)]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq_kv, block_k=bk_kv,
                           causal=causal, scale=scale, rate=rate,
@@ -408,22 +447,23 @@ def _flash_backward(q, k, v, out, lse, g, g_lse, seq_lens, offsets, seed,
             jax.ShapeDtypeStruct(kr.shape, k.dtype),
             jax.ShapeDtypeStruct(vr.shape, v.dtype),
         ],
-        grid=(B * H, Tk // bk_kv),
+        grid=(B * H, Tk // bk_kv, Tq // bq_kv),
         in_specs=[
             _smem_spec(),
             _smem_spec(),
             _smem_spec(),
-            pl.BlockSpec((1, Tq, D), lambda b, s: (b, 0, 0)),
-            pl.BlockSpec((1, bk_kv, D), lambda b, s: (b, s, 0)),
-            pl.BlockSpec((1, bk_kv, D), lambda b, s: (b, s, 0)),
-            pl.BlockSpec((1, Tq, D), lambda b, s: (b, 0, 0)),
-            pl.BlockSpec((1, Tq, _LSE_LANES), lambda b, s: (b, 0, 0)),
-            pl.BlockSpec((1, Tq, _LSE_LANES), lambda b, s: (b, 0, 0)),
+            pl.BlockSpec((1, bq_kv, D), _qmap),
+            pl.BlockSpec((1, bk_kv, D), lambda b, s, j: (b, s, 0)),
+            pl.BlockSpec((1, bk_kv, D), lambda b, s, j: (b, s, 0)),
+            pl.BlockSpec((1, bq_kv, D), _qmap),
+            pl.BlockSpec((1, bq_kv, _LSE_LANES), _qmap),
+            pl.BlockSpec((1, bq_kv, _LSE_LANES), _qmap),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk_kv, D), lambda b, s: (b, s, 0)),
-            pl.BlockSpec((1, bk_kv, D), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, bk_kv, D), lambda b, s, j: (b, s, 0)),
+            pl.BlockSpec((1, bk_kv, D), lambda b, s, j: (b, s, 0)),
         ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(lens, seed_arr, off_arr, qr, kr, vr, do, lse, delta)
 
@@ -624,9 +664,14 @@ def _fa_fwd(q, k, v, seq_lens, offsets, seed, causal, scale, rate, block_q,
     return (out, lse_pub), (q, k, v, out, lse, seq_lens, offsets, seed)
 
 
-def _fa_bwd(causal, scale, rate, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse, seq_lens, offsets, seed = res
-    g_out, g_lse = g
+def _fa_bwd_core(q, k, v, out, lse_k, g_out, g_lse, seq_lens, offsets,
+                 seed, causal, scale, rate, block_q, block_k, interpret):
+    """Shared backward preamble for both custom_vjps: the
+    PADDLE_TPU_FLASH_BWD=xla escape hatch (with its dropout/offset
+    guards), the table-driven per-kernel block choice, and the
+    _flash_backward dispatch. ``lse_k`` is the kernel-layout
+    [B*H, Tq, _LSE_LANES] residual; ``g_lse`` the public [B, H, Tq]
+    cotangent (or None)."""
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     Tq, Tk = q.shape[2], k.shape[2]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
@@ -643,11 +688,15 @@ def _fa_bwd(causal, scale, rate, block_q, block_k, interpret, res, g):
         # escape hatch: recompute attention in XLA (O(T^2) intermediates)
         # for chips where the backward kernels fail to lower. Differentiate
         # the (out, lse) pair so a caller's lse cotangent is not dropped.
+        B, H, _ = g_lse.shape if g_lse is not None else (q.shape[0],
+                                                        q.shape[1], Tq)
+        gl = (g_lse if g_lse is not None
+              else jnp.zeros((B, H, Tq), jnp.float32))
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _xla_attention_lse(q_, k_, v_, causal,
                                                   scale_, seq_lens),
             q, k, v)
-        return (*vjp((g_out, g_lse)), None, None, None)
+        return vjp((g_out, gl))
     # table-driven per-kernel blocks apply ONLY when the caller used the
     # table's own forward defaults — an explicit block choice (e.g. to
     # bound VMEM) is never overridden
@@ -656,14 +705,65 @@ def _fa_bwd(causal, scale, rate, block_q, block_k, interpret, res, g):
         dq_blocks, dkv_blocks = pick_bwd_blocks(Tq, Tk, q.dtype, (bq, bk))
     else:
         dq_blocks = dkv_blocks = (bq, bk)
-    dq, dk, dv = _flash_backward(q, k, v, out, lse, g_out, g_lse, seq_lens,
-                                 offsets, seed, causal, scale_, rate, bq, bk,
-                                 interpret, dq_blocks=dq_blocks,
-                                 dkv_blocks=dkv_blocks)
+    return _flash_backward(q, k, v, out, lse_k, g_out, g_lse, seq_lens,
+                           offsets, seed, causal, scale_, rate, bq, bk,
+                           interpret, dq_blocks=dq_blocks,
+                           dkv_blocks=dkv_blocks)
+
+
+def _fa_bwd(causal, scale, rate, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, seq_lens, offsets, seed = res
+    g_out, g_lse = g
+    dq, dk, dv = _fa_bwd_core(q, k, v, out, lse, g_out, g_lse, seq_lens,
+                              offsets, seed, causal, scale, rate, block_q,
+                              block_k, interpret)
     return dq, dk, dv, None, None, None
 
 
 flash_attention_lse.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def flash_attention_raw_lse(q, k, v, seq_lens, seed, causal, scale, rate,
+                            block_q, block_k, interpret):
+    """``flash_attention_lse`` with the logsumexp kept in the kernel's
+    native [B, H, Tq, _LSE_LANES] tiling (the form the fused_attention op
+    saves so the backward read is relayout-free). Carrying its own
+    custom_vjp makes the op LOWERING differentiable by jax autodiff —
+    the remat lowering (engine/lowering.py lower_block_remat) gradients
+    the composed forward instead of running the registered grad op, so
+    the pallas_call must not be left to jax's default jvp."""
+    out, lse = _flash_forward(q, k, v, seq_lens, None, seed, causal,
+                              scale, rate, block_q, block_k, interpret)
+    B, H, Tq = q.shape[0], q.shape[1], q.shape[2]
+    return out, lse.reshape(B, H, Tq, -1)
+
+
+def _fa_raw_fwd(q, k, v, seq_lens, seed, causal, scale, rate, block_q,
+                block_k, interpret):
+    out, lse = _flash_forward(q, k, v, seq_lens, None, seed, causal,
+                              scale, rate, block_q, block_k, interpret)
+    B, H, Tq = q.shape[0], q.shape[1], q.shape[2]
+    lse_raw = lse.reshape(B, H, Tq, -1)
+    return (out, lse_raw), (q, k, v, out, lse_raw, seq_lens, seed)
+
+
+def _fa_raw_bwd(causal, scale, rate, block_q, block_k, interpret,
+                res, g):
+    q, k, v, out, lse_raw, seq_lens, seed = res
+    g_out, g_lse_raw = g
+    B, H, Tq, _ = q.shape
+    # raw lse replicates the row value across lanes, so the public
+    # cotangent is the lane sum (zeros when nothing consumed the lse)
+    g_lse = None if g_lse_raw is None else g_lse_raw.sum(axis=-1)
+    lse_k = lse_raw.reshape(B * H, Tq, -1)
+    dq, dk, dv = _fa_bwd_core(q, k, v, out, lse_k, g_out, g_lse, seq_lens,
+                              None, seed, causal, scale, rate, block_q,
+                              block_k, interpret)
+    return dq, dk, dv, None, None
+
+
+flash_attention_raw_lse.defvjp(_fa_raw_fwd, _fa_raw_bwd)
 
 
 def _on_tpu():
@@ -724,10 +824,9 @@ def dispatch_attention_lse(q, k, v, causal=False, scale=None, seq_lens=None,
     if use_pallas:
         if raw_lse:
             _check_tileable(q, k, bq, bk)
-            out, lse = _flash_forward(
-                q, k, v, seq_lens, None, seed, causal, scale_,
+            return flash_attention_raw_lse(
+                q, k, v, seq_lens, seed, causal, scale_,
                 dropout_rate, bq, bk, not _on_tpu())
-            return out, lse.reshape(B, H, Tq, -1)
         return flash_attention_lse(q, k, v, seq_lens, None, seed, causal,
                                    scale_, dropout_rate, bq, bk,
                                    not _on_tpu())

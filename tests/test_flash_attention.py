@@ -97,6 +97,25 @@ class TestSeqLensMask:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-4)
 
+    def test_causal_cross_attention_grads_tk_gt_tq(self):
+        """Tk > Tq with causal=True: every k block past the last q row is
+        a fully-skipped dkv grid step whose fetch index must clamp to the
+        last REAL q block (the streamed-kernel regression case)."""
+        B, H, Tq, Tk, D = 1, 2, 64, 256, 16
+        q = _rand((B, H, Tq, D), 3)
+        k, v = _rand((B, H, Tk, D), 4), _rand((B, H, Tk, D), 5)
+
+        def f(fn):
+            return jax.grad(lambda a, b, c: jnp.sum(
+                fn(a, b, c).astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v)
+
+        got = f(lambda a, b, c: _flash(a, b, c, True, block_q=32,
+                                       block_k=64))
+        want = f(lambda a, b, c: _xla_attention(a, b, c, True, D ** -0.5))
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=5e-4, rtol=5e-3)
+
 
 class TestInKernelDropout:
     """Counter-based hash-RNG attention dropout: deterministic given the
