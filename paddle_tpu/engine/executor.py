@@ -99,6 +99,7 @@ class Engine:
         data_axes=("dp",),
         amp=False,
         accumulate_steps=1,
+        remat_segments=0,
     ):
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -108,7 +109,8 @@ class Engine:
             program_desc, block_idx, feed_names, feed_values, fetch_list,
             is_test, donate_state, amp, accumulate_steps,
             cache_key_extra=cache_key_extra, mesh=mesh,
-            shard_rules=shard_rules, data_axes=data_axes)
+            shard_rules=shard_rules, data_axes=data_axes,
+            remat_segments=remat_segments)
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
@@ -189,7 +191,7 @@ class Engine:
     def get_compiled(self, program_desc, block_idx, feed_names, feed_values,
                      fetch_list, is_test, donate_state, amp,
                      accumulate_steps, cache_key_extra=None, mesh=None,
-                     shard_rules=None, data_axes=("dp",)):
+                     shard_rules=None, data_axes=("dp",), remat_segments=0):
         """LRU-cached executable lookup/compile for one (program, feed
         signature) — shared by ``run_block`` and the Executor's
         ``cost_analysis`` so an analysis compiles exactly the executable
@@ -204,6 +206,7 @@ class Engine:
             donate_state,
             amp,
             accumulate_steps,
+            remat_segments,
             cache_key_extra,
         )
         compiled = self._cache.get(key)
@@ -213,6 +216,7 @@ class Engine:
                 is_test, donate_state, mesh=mesh, feed_values=feed_values,
                 shard_rules=shard_rules, data_axes=data_axes, amp=amp,
                 accumulate_steps=accumulate_steps,
+                remat_segments=remat_segments,
             )
             self._cache[key] = compiled
             while len(self._cache) > self._cache_capacity:
@@ -235,13 +239,36 @@ class Engine:
     # -- internals ---------------------------------------------------------
     def _compile(self, block, feed_names, fetch_list, is_test, donate_state,
                  mesh=None, feed_values=None, shard_rules=None,
-                 data_axes=("dp",), amp=False, accumulate_steps=1):
-        bp = BlockProgram(block, feed_names, fetch_list, ())
+                 data_axes=("dp",), amp=False, accumulate_steps=1,
+                 remat_segments=0):
+        if accumulate_steps > 1 and remat_segments:
+            raise NotImplementedError(
+                "accumulate_steps and remat_segments cannot combine yet; "
+                "pick one memory lever per program")
+        extra_live = ()
+        if remat_segments:
+            # keep the loss-computing ops alive: the remat lowering
+            # differentiates the loss VALUE, which the explicit grad
+            # chain never reads (its seed is a fill op), so plain DCE
+            # would prune it whenever the loss is not fetched
+            extra_live = tuple(
+                n[: -len("@GRAD")]
+                for op in block.ops
+                if op.attrs.get("__is_loss_grad__")
+                for n in op.output_arg_names() if n.endswith("@GRAD"))
+        bp = BlockProgram(block, feed_names, fetch_list, (),
+                          extra_live_vars=extra_live)
         if accumulate_steps > 1:
             from paddle_tpu.engine.lowering import lower_block_accumulated
 
             fn = lower_block_accumulated(
                 bp, accumulate_steps, is_test=is_test, executor=self,
+                amp=amp)
+        elif remat_segments:
+            from paddle_tpu.engine.lowering import lower_block_remat
+
+            fn = lower_block_remat(
+                bp, remat_segments, is_test=is_test, executor=self,
                 amp=amp)
         else:
             fn = lower_block(bp, is_test=is_test, executor=self, amp=amp)

@@ -39,7 +39,7 @@ class BlockProgram:
     which are outputs (fetches + state written)."""
 
     def __init__(self, block, feed_names, fetch_names, scope_var_names,
-                 extra_state_outputs=()):
+                 extra_state_outputs=(), extra_live_vars=()):
         self.block = block
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
@@ -57,7 +57,11 @@ class BlockProgram:
             vd = block.find_var_recursive(name)
             return vd is not None and vd.persistable
 
-        live_vars = set(self.fetch_names) | set(extra_state_outputs)
+        # extra_live_vars: liveness-only roots (no output slot) — the
+        # remat lowering keeps the loss-computing ops alive with these
+        # even when nothing in the explicit grad chain reads the loss
+        live_vars = (set(self.fetch_names) | set(extra_state_outputs)
+                     | set(extra_live_vars))
         live_flags = [False] * len(all_ops)
         for i in range(len(all_ops) - 1, -1, -1):
             op = all_ops[i]
@@ -280,6 +284,223 @@ def _lower_grad_op(op, block, ins, rng_key, is_test):
                 cleaned.append(g)
         outs[s + "@GRAD"] = cleaned
     return outs
+
+
+def lower_block_remat(block_program, n_segments, is_test=False,
+                      executor=None, amp=False):
+    """Rematerialized training-step lowering: the forward segment runs as
+    a chain of ``jax.checkpoint`` blocks and the parameter gradients come
+    from ``jax.value_and_grad`` of that chain instead of the program's
+    explicit ``*_grad`` ops — so only segment-boundary activations
+    survive from forward to backward and everything inside a segment is
+    recomputed on demand. This is the TPU-native descendant of the
+    reference's memory optimization passes (reference:
+    framework/details/memory_optimize_pass.cc and
+    transpiler/memory_optimization_transpiler.py, which reuse buffers by
+    lifetime analysis): under XLA the buffer reuse itself is automatic,
+    so the lever that remains is trading recompute FLOPs for backward
+    activation MEMORY — which is what bounds long-context batch sizes
+    and conv-net peak batch.
+
+    Numerics: the Backward segment appended by ``append_backward`` is
+    pure autodiff (clip/regularizer/optimizer ops all carry the
+    Optimize role), and every registered grad lowering is the analytic
+    derivative of its forward lowering, so differentiating the composed
+    forward produces the same gradients the explicit chain does (the
+    parity tests assert it). Sparse (SelectedRows) gradients densify.
+    The Optimize-role tail runs unchanged on the bound ``p@GRAD`` vars.
+
+    Not supported (raises ``NotImplementedError``): programs fetching
+    gradients of intermediate (non-feed, non-state) vars, and programs
+    whose optimizer consumes backward-written vars that are not
+    ``<var>@GRAD``.
+    """
+    import jax
+
+    from paddle_tpu.core.registry import amp_scope
+    from paddle_tpu.core.selected_rows import densify
+    from paddle_tpu.framework import OpRole
+
+    block = block_program.block
+    feed_names = block_program.feed_names
+    state_in_names = block_program.state_in_names
+
+    TAIL_ROLES = OpRole.Optimize | OpRole.RPC | OpRole.Dist | OpRole.LRSched
+    fwd_ops, bwd_ops, tail_ops = [], [], []
+    for i, op in enumerate(block_program.ops):
+        role = int(op.attrs.get("op_role", 0))
+        if role & OpRole.Backward:
+            bwd_ops.append((i, op))
+        elif role & TAIL_ROLES:
+            tail_ops.append((i, op))
+        else:
+            fwd_ops.append((i, op))
+    if not bwd_ops:
+        raise NotImplementedError(
+            "remat lowering requires a training program (no Backward-role "
+            "ops found); run test/inference programs without remat")
+
+    # the losses: append_backward marks each chain seed
+    losses, bwd_real = [], []
+    for i, op in bwd_ops:
+        if op.attrs.get("__is_loss_grad__"):
+            gname = next(n for n in op.output_arg_names()
+                         if n != EMPTY_VAR_NAME)
+            losses.append((gname[: -len("@GRAD")],
+                           float(op.attrs.get("value", 1.0))))
+        else:
+            bwd_real.append((i, op))
+    if not losses:
+        raise NotImplementedError(
+            "remat lowering found no @GRAD seed op (calc_gradient-style "
+            "programs are not supported)")
+
+    bwd_written = set()
+    for _, op in bwd_real:
+        bwd_written.update(
+            n for n in op.output_arg_names() if n != EMPTY_VAR_NAME)
+    tail_read = set()
+    for _, op in tail_ops:
+        tail_read.update(
+            n for n in op.input_arg_names() if n != EMPTY_VAR_NAME)
+    fetch_set = set(block_program.fetch_names)
+
+    # persistable side effects inside the (skipped) backward segment have
+    # no remat equivalent — refuse rather than silently serve stale state
+    bwd_persist = sorted(set(block_program.state_out_names) & bwd_written)
+    if bwd_persist:
+        raise NotImplementedError(
+            "remat: backward-role ops write persistable vars %s; the "
+            "remat lowering replaces the explicit backward chain and "
+            "cannot replay those side effects" % bwd_persist)
+
+    needed_grads = sorted((tail_read | fetch_set) & bwd_written)
+    feed_set, state_set = set(feed_names), set(state_in_names)
+    diff_names = []
+    for g in needed_grads:
+        if not g.endswith("@GRAD"):
+            raise NotImplementedError(
+                "remat: optimizer/fetch consumes backward var %r that is "
+                "not a gradient" % g)
+        p = g[: -len("@GRAD")]
+        if p not in feed_set and p not in state_set:
+            raise NotImplementedError(
+                "remat: gradient of intermediate var %r requested; only "
+                "parameter/feed gradients survive the remat lowering" % p)
+        diff_names.append(p)
+
+    fwd_written = set()
+    for _, op in fwd_ops:
+        fwd_written.update(
+            n for n in op.output_arg_names() if n != EMPTY_VAR_NAME)
+    state_out_set = set(block_program.state_out_names)
+    aux_names = sorted(
+        (tail_read | fetch_set | state_out_set | {l for l, _ in losses})
+        & fwd_written)
+
+    # contiguous segments; boundary vars = reads-from-outside per segment
+    nseg = max(1, min(int(n_segments), len(fwd_ops)))
+    bounds = [len(fwd_ops) * s // nseg for s in range(nseg + 1)]
+    segments = [fwd_ops[bounds[s]: bounds[s + 1]] for s in range(nseg)]
+    aux_left = set(aux_names)
+    seg_descs = []  # (ops, in_names, out_names)
+    produced_before = feed_set | state_set
+    for s, seg in enumerate(segments):
+        writes, reads = [], []
+        wset, rset = set(), set()
+        for _, op in seg:
+            for n in op.input_arg_names():
+                if (n != EMPTY_VAR_NAME and n not in wset
+                        and n not in rset and n in produced_before):
+                    reads.append(n)
+                    rset.add(n)
+            for n in op.output_arg_names():
+                if n != EMPTY_VAR_NAME and n not in wset:
+                    writes.append(n)
+                    wset.add(n)
+        later_reads = set()
+        for later in segments[s + 1:]:
+            for _, op in later:
+                later_reads.update(op.input_arg_names())
+        outs = [n for n in writes if n in later_reads or n in aux_left]
+        seg_descs.append((seg, reads, outs))
+        produced_before |= wset
+
+    # stop_gradient vars (trace-time static set): replicate
+    # append_backward's pruning — a marked var must not pass gradient to
+    # ANY consumer, so the barrier applies right as the op binds it
+    _sg_names = set()
+    for _, op in fwd_ops:
+        for n in op.output_arg_names():
+            if n == EMPTY_VAR_NAME:
+                continue
+            vd = block.find_var_recursive(n)
+            if vd is not None and vd.stop_gradient and not vd.is_parameter:
+                _sg_names.add(n)
+
+    def _sg_op_outputs(op, env):
+        for n in op.output_arg_names():
+            if (n in _sg_names and hasattr(env.get(n), "dtype")
+                    and jnp.issubdtype(env[n].dtype, jnp.floating)):
+                env[n] = jax.lax.stop_gradient(env[n])
+
+    def fn(feed_values, state_values, rng_key):
+        base = {}
+        for name, val in zip(feed_names, feed_values):
+            base[name] = val
+        for name, val in zip(state_in_names, state_values):
+            base[name] = val
+        diff_set = set(diff_names)
+        others = {k: v for k, v in base.items() if k not in diff_set}
+
+        def seg_callable(seg, in_names, out_names):
+            def run_seg(key, *in_vals):
+                env = dict(others)
+                env.update(zip(in_names, in_vals))
+                with amp_scope(amp):
+                    for j, op in seg:
+                        run_op(op, block, env, key, j, is_test, executor)
+                        _sg_op_outputs(op, env)
+                return tuple(env[n] for n in out_names)
+            return run_seg
+
+        def loss_fn(diff_vals):
+            env = dict(others)
+            env.update(zip(diff_names, diff_vals))
+            for seg, in_names, out_names in seg_descs:
+                seg_f = jax.checkpoint(
+                    seg_callable(seg, in_names, out_names))
+                outs = seg_f(rng_key, *[env[n] for n in in_names])
+                env.update(zip(out_names, outs))
+            total = jnp.float32(0.0)
+            for lname, seed in losses:
+                total = total + jnp.sum(
+                    env[lname].astype(jnp.float32)) * seed
+            return total, tuple(env[n] for n in aux_names)
+
+        diff_vals = tuple(base[p] for p in diff_names)
+        (_, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(diff_vals)
+
+        env = dict(base)
+        env.update(zip(aux_names, aux))
+        for p, g in zip(diff_names, grads):
+            env[p + "@GRAD"] = g.astype(base[p].dtype)
+        # the seed vars the fill ops would have produced (a fetch of
+        # loss@GRAD must serve the same constant the explicit chain binds)
+        for lname, seed_val in losses:
+            env[lname + "@GRAD"] = jnp.full_like(env[lname], seed_val)
+
+        with amp_scope(amp):
+            for j, op in tail_ops:
+                run_op(op, block, env, rng_key, j, is_test, executor)
+
+        fetches = [densify(env[n]) for n in block_program.fetch_names]
+        state_out = [densify(env[n])
+                     for n in block_program.state_out_names]
+        return fetches, state_out
+
+    return fn
 
 
 def np_value_for_var(var_desc, value):

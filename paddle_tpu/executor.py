@@ -62,7 +62,7 @@ class Executor:
         self.engine._cache.clear()
 
     def cost_analysis(self, program=None, feed=None, fetch_list=None,
-                      scope=None, accumulate_steps=1):
+                      scope=None, accumulate_steps=1, remat_segments=0):
         """XLA's cost and memory analysis of the compiled step — the
         roofline workflow as a first-class API (round 5 used it to pin
         ResNet-50 at 145.5 GB/step against 670 GB/s achieved; see
@@ -104,7 +104,8 @@ class Executor:
         compiled = self.engine.get_compiled(
             program.desc, 0, feed_names, feed_values, fetch_names,
             getattr(program, "_is_test", False), True,
-            getattr(program, "_amp", False), accumulate_steps)
+            getattr(program, "_amp", False), accumulate_steps,
+            remat_segments=remat_segments)
         mutated = [self.engine._state_value(scope, n)
                    for n in compiled.mutated_names]
         readonly = [self.engine._state_value(scope, n)
@@ -131,18 +132,31 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
-            use_program_cache=True, accumulate_steps=1):
+            use_program_cache=True, accumulate_steps=1, remat_segments=0):
         """``accumulate_steps=k`` runs the feed as k micro-batches through a
         compiled scan with one optimizer update on the averaged gradients —
         the batch-merge capability (reference:
         framework/ir/multi_batch_merge_pass.cc; see
-        engine/lowering.py lower_block_accumulated)."""
+        engine/lowering.py lower_block_accumulated).
+
+        ``remat_segments=s`` compiles the training step with the forward
+        partitioned into ``s`` ``jax.checkpoint`` segments and gradients
+        taken through them — only segment-boundary activations survive to
+        the backward pass, trading recompute for the activation memory
+        that bounds long-context/large-batch training (see
+        engine/lowering.py lower_block_remat; the TPU-native form of the
+        reference's memory-optimization passes)."""
         from paddle_tpu.compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()
         fetch_list = fetch_list or []
 
         if isinstance(program, CompiledProgram):
+            if remat_segments:
+                raise NotImplementedError(
+                    "remat_segments is not supported on the CompiledProgram "
+                    "(SPMD) path yet; pass the plain Program, or combine "
+                    "sharding with accumulate_steps for memory headroom")
             return program._run(self, feed, fetch_list, scope, return_numpy)
 
         if program is None:
@@ -174,4 +188,5 @@ class Executor:
             seed=getattr(program, "random_seed", 0) or 0,
             amp=getattr(program, "_amp", False),
             accumulate_steps=accumulate_steps,
+            remat_segments=remat_segments,
         )
