@@ -108,23 +108,37 @@ def _causal_blocks(q_off, k_off, j, block_q, block_k):
 
 
 def _attn_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
-                 lse_ref, *, block_q, block_k, causal, scale, rate, masked):
+                 lse_ref, acc_s, m_s, l_s, *, block_q, block_k, causal,
+                 scale, rate, masked, t_k):
+    """Online-softmax forward with K/V STREAMED over the innermost grid
+    axis (grid = (B*H, Tq/block_q, Tk/block_k)) and the (acc, m, l)
+    carry in VMEM scratch — VMEM bounded by the block sizes, not Tk
+    (the resident-K/V form capped context at ~8k: seq-16384 overran the
+    16MB scoped limit in this kernel by 768KB)."""
     b = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[0]  # [block_q, D], kept in input dtype for MXU-rate matmuls
-    t_k = k_ref.shape[1]
-    nk = t_k // block_k
+    s = pl.program_id(2)
+    ns = pl.num_programs(2)
     length = len_ref[b]
     seed = seed_ref[0]
     q_off, k_off = off_ref[0], off_ref[1]
 
-    q_pos = j * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    @pl.when(s == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, _NEG)
+        l_s[...] = jnp.zeros_like(l_s)
 
-    def body(s, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :]
+    causal_hi = _causal_blocks(q_off, k_off, j, block_q, block_k)
+    nk_eff = _nk_limit(ns, causal_hi, length, block_k, masked, causal)
+
+    @pl.when(s < nk_eff)
+    def _step():
+        q = q_ref[0]                           # [block_q, D], input dtype
+        k_blk = k_ref[0]                       # [block_k, D]
+        v_blk = v_ref[0]
+        q_pos = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -134,39 +148,63 @@ def _attn_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
             sij = jnp.where(q_pos + q_off >= k_pos + k_off, sij, _NEG)
         if masked:
             sij = jnp.where(k_pos < length, sij, _NEG)
+        m = m_s[...]
         m_new = jnp.maximum(m, jnp.max(sij, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(sij - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        l_s[...] = l_s[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_s[...] = m_new
         if rate > 0.0:
             keep = _keep_mask(seed, b, q_pos, k_pos, t_k, rate)
             p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - rate))
         else:
             p_acc = p
-        acc_new = acc * corr + jax.lax.dot_general(
+        acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
             p_acc.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
 
-    acc0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    @pl.when(s == ns - 1)
+    def _emit():
+        acc, m, l = acc_s[...], m_s[...], l_s[...]
+        # a row with EVERY key masked keeps m at _NEG, making p = exp(0)
+        # = 1 garbage — zero it so the row publishes out = 0,
+        # lse ~= -1e30 (the "no contribution" value the ring merge
+        # expects). Without this guard only block-aligned offsets would
+        # be safe.
+        l = jnp.where(m > 0.5 * _NEG, l, 0.0)
+        acc = jnp.where(m > 0.5 * _NEG, acc, 0.0)
+        o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # logsumexp per row, the softmax residual the backward kernels
+        # re-derive p from (FlashAttention-2's L); replicated across the
+        # lane dim so the block stays (8, 128)-tileable
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [block_q, 1]
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LSE_LANES))
 
-    causal_hi = _causal_blocks(q_off, k_off, j, block_q, block_k)
-    nk_eff = _nk_limit(nk, causal_hi, length, block_k, masked, causal)
-    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
-    # a row with EVERY key masked keeps m at _NEG, making p = exp(0) = 1
-    # garbage — zero it so the row publishes out = 0, lse ~= -1e30 (the
-    # "no contribution" value the ring merge expects). Without this guard
-    # only block-aligned offsets would be safe.
-    l = jnp.where(m > 0.5 * _NEG, l, 0.0)
-    acc = jnp.where(m > 0.5 * _NEG, acc, 0.0)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # logsumexp per row, the softmax residual the backward kernels re-derive
-    # p from (FlashAttention-2's L); replicated across the lane dim so the
-    # block stays (8, 128)-tileable
-    lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [block_q, 1]
-    lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LSE_LANES))
+
+def _stream_kvmap(block_q, block_k, causal, offsets):
+    """Index map for K/V blocks streamed over the innermost grid axis of
+    a (b, q-block, k-block) grid. For causal runs without (traced) ring
+    offsets the fetch index clamps to the causal frontier so skipped
+    steps re-fetch the block a live step needs (consecutive equal
+    indices elide the copy); ring-step offsets keep the identity map —
+    wasted fetches on skipped steps, never wrong."""
+    if causal and offsets is None:
+        def kvmap(b, j, s):
+            return (b, jnp.minimum(s, ((j + 1) * block_q - 1) // block_k),
+                    0)
+    else:
+        def kvmap(b, j, s):
+            return (b, s, 0)
+    return kvmap
+
+
+def _require_pltpu(what):
+    if not _HAS_PLTPU:
+        raise RuntimeError(
+            "flash %s needs pallas-TPU scratch support (pltpu "
+            "unimportable here); use the XLA fallback (forward: the "
+            "plain composition; backward: PADDLE_TPU_FLASH_BWD=xla)"
+            % what)
 
 
 def _offsets_arr(offsets):
@@ -195,53 +233,70 @@ def _flash_forward(q, k, v, seq_lens, offsets, seed, causal, scale, rate,
         lens = jnp.full((B * H,), Tk, jnp.int32)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1)
 
+    _require_pltpu("forward")
+    _kvmap = _stream_kvmap(block_q, block_k, causal, offsets)
     kernel = functools.partial(
         _attn_kernel, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, rate=rate, masked=masked)
+        scale=scale, rate=rate, masked=masked, t_k=Tk)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
             jax.ShapeDtypeStruct(qr.shape, q.dtype),
             jax.ShapeDtypeStruct((B * H, Tq, _LSE_LANES), jnp.float32),
         ],
-        grid=grid,
+        grid=grid + (Tk // block_k,),
         in_specs=[
             _smem_spec(),
             _smem_spec(),
             _smem_spec(),
-            pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, s: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), _kvmap),
+            pl.BlockSpec((1, block_k, D), _kvmap),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, s: (b, j, 0)),
+            pl.BlockSpec((1, block_q, _LSE_LANES),
+                         lambda b, j, s: (b, j, 0)),
         ],
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
         interpret=interpret,
     )(lens, seed_arr, _offsets_arr(offsets), qr, kr, vr)
     return out.reshape(B, H, Tq, D), lse
 
 
 def _bwd_dq_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
-                   lse_ref, delta_ref, dq_ref, *, block_q, block_k, causal,
-                   scale, rate, masked):
+                   lse_ref, delta_ref, dq_ref, dq_acc, *, block_q, block_k,
+                   causal, scale, rate, masked, t_k):
+    """dQ with K/V streamed over the innermost grid axis and the dq
+    accumulator in VMEM scratch (same restructure as the forward — the
+    resident-K/V form's VMEM grew with Tk)."""
     b = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[0]                              # [block_q, D]
-    do = do_ref[0]                            # [block_q, D]
-    lse = lse_ref[0][:, :1]                   # [block_q, 1]
-    delta = delta_ref[0][:, :1]               # [block_q, 1]
-    t_k = k_ref.shape[1]
-    nk = t_k // block_k
+    s = pl.program_id(2)
+    ns = pl.num_programs(2)
     length = len_ref[b]
     seed = seed_ref[0]
     q_off, k_off = off_ref[0], off_ref[1]
-    q_pos = j * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
 
-    def body(s, dq):
-        k_blk = k_ref[0, pl.ds(s * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(s * block_k, block_k), :]
+    @pl.when(s == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    causal_hi = _causal_blocks(q_off, k_off, j, block_q, block_k)
+    nk_eff = _nk_limit(ns, causal_hi, length, block_k, masked, causal)
+
+    @pl.when(s < nk_eff)
+    def _step():
+        q = q_ref[0]                          # [block_q, D]
+        do = do_ref[0]                        # [block_q, D]
+        lse = lse_ref[0][:, :1]               # [block_q, 1]
+        delta = delta_ref[0][:, :1]           # [block_q, 1]
+        k_blk = k_ref[0]                      # [block_k, D]
+        v_blk = v_ref[0]
+        q_pos = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         sij = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -261,15 +316,13 @@ def _bwd_dq_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
             keep = _keep_mask(seed, b, q_pos, k_pos, t_k, rate)
             dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - rate))
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
+        dq_acc[...] += jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    causal_hi = _causal_blocks(q_off, k_off, j, block_q, block_k)
-    nk_eff = _nk_limit(nk, causal_hi, length, block_k, masked, causal)
-    dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
-    dq = jax.lax.fori_loop(0, nk_eff, body, dq0)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(s == ns - 1)
+    def _emit():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(len_ref, seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
@@ -392,24 +445,29 @@ def _flash_backward(q, k, v, out, lse, g, g_lse, seq_lens, offsets, seed,
         delta = delta - g_lse.reshape(B * H, Tq).astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (B * H, Tq, _LSE_LANES))
 
+    _require_pltpu("backward")
+    _kvmap_dq = _stream_kvmap(bq_dq, bk_dq, causal, offsets)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq_dq, block_k=bk_dq,
                           causal=causal, scale=scale, rate=rate,
-                          masked=masked),
+                          masked=masked, t_k=Tk),
         out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
-        grid=(B * H, Tq // bq_dq),
+        grid=(B * H, Tq // bq_dq, Tk // bk_dq),
         in_specs=[
             _smem_spec(),
             _smem_spec(),
             _smem_spec(),
-            pl.BlockSpec((1, bq_dq, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bq_dq, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bq_dq, _LSE_LANES), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bq_dq, _LSE_LANES), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bq_dq, D), lambda b, j, s: (b, j, 0)),
+            pl.BlockSpec((1, bk_dq, D), _kvmap_dq),
+            pl.BlockSpec((1, bk_dq, D), _kvmap_dq),
+            pl.BlockSpec((1, bq_dq, D), lambda b, j, s: (b, j, 0)),
+            pl.BlockSpec((1, bq_dq, _LSE_LANES),
+                         lambda b, j, s: (b, j, 0)),
+            pl.BlockSpec((1, bq_dq, _LSE_LANES),
+                         lambda b, j, s: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq_dq, D), lambda b, j: (b, j, 0)),
+        out_specs=pl.BlockSpec((1, bq_dq, D), lambda b, j, s: (b, j, 0)),
+        scratch_shapes=[pltpu.VMEM((bq_dq, D), jnp.float32)],
         interpret=interpret,
     )(lens, seed_arr, off_arr, qr, kr, vr, do, lse, delta)
 
@@ -433,10 +491,6 @@ def _flash_backward(q, k, v, out, lse, g, g_lse, seq_lens, offsets, seed,
     else:
         def _qmap(b, s, j):
             return (b, j, 0)
-    if not _HAS_PLTPU:
-        raise RuntimeError(
-            "flash backward needs pallas-TPU scratch support (pltpu "
-            "unimportable here); set PADDLE_TPU_FLASH_BWD=xla instead")
     scratch = [pltpu.VMEM((bk_kv, D), jnp.float32),
                pltpu.VMEM((bk_kv, D), jnp.float32)]
     dk, dv = pl.pallas_call(
