@@ -43,7 +43,10 @@ def _dump(table):
         json.dump(table, f, indent=1, sort_keys=True)
 
 
-def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=(128, 256, 512),
+DEFAULT_BLOCKS = (128, 256, 512, 1024)
+
+
+def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=DEFAULT_BLOCKS,
           dtypes=("bfloat16", "float32"), batch=4, heads=16, dim=64,
           reps=3, target_signal_s=3.0, fresh=False):
     import jax
@@ -139,7 +142,14 @@ if __name__ == "__main__":
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--fresh", action="store_true",
                     help="ignore the existing table, regenerate")
+    ap.add_argument("--blocks", type=int, nargs="+",
+                    default=list(DEFAULT_BLOCKS),
+                    help="candidate block sizes (the streamed kernels "
+                         "keep VMEM bounded by block size, so wide "
+                         "candidates like 1024 are in the default set "
+                         "— a default re-sweep must never clobber a "
+                         "committed wide-block winner)")
     a = ap.parse_args()
     sweep(seqs=tuple(a.seqs), dtypes=tuple(a.dtypes), reps=a.reps,
-          fresh=a.fresh)
+          blocks=tuple(a.blocks), fresh=a.fresh)
     print("wrote", OUT)
