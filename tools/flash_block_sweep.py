@@ -79,9 +79,11 @@ def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=DEFAULT_BLOCKS,
             dn = int(min(4096, max(64, target_signal_s / est_s)))
             n_lo, n_hi = 4, 4 + dn
             variants = {}
+            any_tiled = False
             for blk in blocks:
                 if seq % blk:
                     continue
+                any_tiled = True
                 g = jax.grad(
                     lambda a, c, d, _blk=blk: jnp.sum(flash_attention(
                         a, c, d, None, 0, True, None, 0.0, _blk, _blk,
@@ -100,6 +102,13 @@ def sweep(seqs=(256, 512, 1024, 2048, 4096), blocks=DEFAULT_BLOCKS,
                 variants[blk] = (fn_lo, n_lo,
                                  chained_grad_loop(g, n_hi), n_hi)
             if not variants:
+                if not any_tiled:
+                    # no candidate even tiles this seq (e.g. a narrow
+                    # --blocks selection) — that's a no-measurement, not
+                    # a failure; the committed row must survive
+                    print("dtype=%s seq=%d: no candidate tiles, row "
+                          "kept" % (dtype, seq), flush=True)
+                    continue
                 print("dtype=%s seq=%d: no block compiled, row dropped"
                       % (dtype, seq), flush=True)
                 # a stale committed winner measured under an older
@@ -136,7 +145,8 @@ if __name__ == "__main__":
         description="Re-sweep all rows, or --seqs/--dtypes for one row "
                     "with more --reps; winners merge into the table.")
     ap.add_argument("--seqs", type=int, nargs="+",
-                    default=[256, 512, 1024, 2048, 4096])
+                    default=[256, 512, 1024, 2048, 4096,
+                             8192, 16384])
     ap.add_argument("--dtypes", nargs="+",
                     default=["bfloat16", "float32"])
     ap.add_argument("--reps", type=int, default=3)
