@@ -75,11 +75,15 @@ def _append_backward_impl(loss, block, program, parameter_list, no_grad_set,
             if n not in no_grad:
                 grad_needed.add(n)
 
-    # fill loss@GRAD = 1
+    # fill loss@GRAD = 1; a scalar loss (shape ()) keeps its scalar shape —
+    # `loss.shape or [1]` would promote it to [1] and the grad var's IR
+    # metadata would disagree with the forward var (the verifier's
+    # grad-pairing checker caught this)
     loss_grad_name = grad_var_name(loss.name)
+    seed_shape = list(loss.shape) if loss.shape is not None else [1]
     block.create_var(
         name=loss_grad_name,
-        shape=list(loss.shape or [1]),
+        shape=seed_shape,
         dtype=loss.dtype,
         stop_gradient=True,
     )
@@ -87,7 +91,7 @@ def _append_backward_impl(loss, block, program, parameter_list, no_grad_set,
         type="fill_constant",
         outputs={"Out": [loss_grad_name]},
         attrs={
-            "shape": list(loss.shape or [1]),
+            "shape": seed_shape,
             "dtype": int(loss.dtype),
             "value": 1.0,
             "__is_loss_grad__": True,
@@ -193,8 +197,8 @@ def _append_backward_impl(loss, block, program, parameter_list, no_grad_set,
         # etc. — reference: grad ops declaring forward outputs as inputs,
         # e.g. batch_norm_op.cc BatchNormGradOp's SavedMean/SavedVariance)
         for slot in getattr(info, "grad_needs_outputs", ()):
-            if slot in op.outputs and slot not in grad_inputs:
-                grad_inputs[slot] = list(op.outputs[slot])
+            if slot in op.output_names() and slot not in grad_inputs:
+                grad_inputs[slot] = list(op.output(slot))
         for slot, gnames in out_grad_inputs.items():
             if any(g is not None for g in gnames):
                 # Keep positions aligned with the forward op's output list;

@@ -115,7 +115,8 @@ class CompiledProgram:
             self._mesh = Mesh(devices, axis_names=("dp",))
         return self._mesh
 
-    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+    def _run(self, executor, feed, fetch_list, scope, return_numpy,
+             verify=None):
         if not self._is_data_parallel:
             return executor.engine.run_block(
                 self._program.desc, 0, scope,
@@ -126,6 +127,7 @@ class CompiledProgram:
                 return_numpy=return_numpy,
                 seed=getattr(self._program, "random_seed", 0) or 0,
                 amp=getattr(self._program, "_amp", False),
+                verify=verify,
             )
         mesh = self._get_mesh()
         fetch_names = [
@@ -146,4 +148,5 @@ class CompiledProgram:
             mesh=mesh,
             shard_rules=self._shard_rules,
             data_axes=self._data_axes,
+            verify=verify,
         )

@@ -92,6 +92,14 @@ class OpDesc:
     def output(self, slot):
         return self.outputs.get(slot, [])
 
+    def input_names(self):
+        """Input slot names, in declaration order."""
+        return list(self.inputs)
+
+    def output_names(self):
+        """Output slot names, in declaration order."""
+        return list(self.outputs)
+
     def input_arg_names(self):
         return [n for vs in self.inputs.values() for n in vs]
 

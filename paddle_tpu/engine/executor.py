@@ -100,6 +100,7 @@ class Engine:
         amp=False,
         accumulate_steps=1,
         remat_segments=0,
+        verify=None,
     ):
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -110,7 +111,7 @@ class Engine:
             is_test, donate_state, amp, accumulate_steps,
             cache_key_extra=cache_key_extra, mesh=mesh,
             shard_rules=shard_rules, data_axes=data_axes,
-            remat_segments=remat_segments)
+            remat_segments=remat_segments, verify=verify)
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
@@ -191,7 +192,8 @@ class Engine:
     def get_compiled(self, program_desc, block_idx, feed_names, feed_values,
                      fetch_list, is_test, donate_state, amp,
                      accumulate_steps, cache_key_extra=None, mesh=None,
-                     shard_rules=None, data_axes=("dp",), remat_segments=0):
+                     shard_rules=None, data_axes=("dp",), remat_segments=0,
+                     verify=None):
         """LRU-cached executable lookup/compile for one (program, feed
         signature) — shared by ``run_block`` and the Executor's
         ``cost_analysis`` so an analysis compiles exactly the executable
@@ -211,6 +213,22 @@ class Engine:
         )
         compiled = self._cache.get(key)
         if compiled is None:
+            if verify is None:
+                from paddle_tpu import flags
+
+                verify = flags.get_flag("verify")
+            if verify:
+                # Pre-lowering static verification, once per executable
+                # (cache misses only — zero steady-state overhead). ERROR
+                # findings raise VerificationError with source-level
+                # coordinates instead of a deep trace-time failure.
+                from paddle_tpu.analysis import verify_program
+
+                verify_program(
+                    program_desc, feed_names=feed_names,
+                    fetch_names=fetch_list, mesh=mesh,
+                    shard_rules=shard_rules, data_axes=data_axes,
+                    raise_on_error=True)
             compiled = self._compile(
                 program_desc.block(block_idx), feed_names, fetch_list,
                 is_test, donate_state, mesh=mesh, feed_values=feed_values,

@@ -132,7 +132,8 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
-            use_program_cache=True, accumulate_steps=1, remat_segments=0):
+            use_program_cache=True, accumulate_steps=1, remat_segments=0,
+            verify=None):
         """``accumulate_steps=k`` runs the feed as k micro-batches through a
         compiled scan with one optimizer update on the averaged gradients —
         the batch-merge capability (reference:
@@ -145,7 +146,12 @@ class Executor:
         the backward pass, trading recompute for the activation memory
         that bounds long-context/large-batch training (see
         engine/lowering.py lower_block_remat; the TPU-native form of the
-        reference's memory-optimization passes)."""
+        reference's memory-optimization passes).
+
+        ``verify=True`` (default: the PADDLE_TPU_VERIFY flag) statically
+        verifies the program pre-lowering — once per compiled executable
+        — and raises ``analysis.VerificationError`` on ERROR-severity
+        findings (see paddle_tpu.analysis)."""
         from paddle_tpu.compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()
@@ -157,7 +163,8 @@ class Executor:
                     "remat_segments is not supported on the CompiledProgram "
                     "(SPMD) path yet; pass the plain Program, or combine "
                     "sharding with accumulate_steps for memory headroom")
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            return program._run(self, feed, fetch_list, scope, return_numpy,
+                                verify=verify)
 
         if program is None:
             program = default_main_program()
@@ -189,4 +196,5 @@ class Executor:
             amp=getattr(program, "_amp", False),
             accumulate_steps=accumulate_steps,
             remat_segments=remat_segments,
+            verify=verify,
         )

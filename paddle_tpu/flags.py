@@ -23,6 +23,13 @@ DEFS = {
         bool, False,
         "Verify every fetch/state tensor is finite after each step "
         "(reference: FLAGS_check_nan_inf)."),
+    "verify": (
+        bool, False,
+        "Run the static program verifier (paddle_tpu.analysis) before "
+        "each block is lowered — once per compiled executable, raising "
+        "on ERROR-severity findings (use-before-def, dtype clashes, "
+        "orphan gradients, bad sharding axes...). Source-level "
+        "diagnostics instead of a deep XLA traceback."),
     "executable_cache_size": (
         int, 128,
         "LRU capacity of the engine's compiled-executable cache "

@@ -259,6 +259,28 @@ class Operator:
     def attr(self, name):
         return self.desc.attrs.get(name)
 
+    # -- stable slot accessors (reference: framework.py Operator
+    # input_names/output_names over the C++ OpDesc) — the one sanctioned
+    # way to read an op's interface; analysis/transpiler code should use
+    # these instead of poking the desc dicts.
+    def input_names(self):
+        return self.desc.input_names()
+
+    def output_names(self):
+        return self.desc.output_names()
+
+    def input(self, slot):
+        return self.desc.input(slot)
+
+    def output(self, slot):
+        return self.desc.output(slot)
+
+    def input_arg_names(self):
+        return self.desc.input_arg_names()
+
+    def output_arg_names(self):
+        return self.desc.output_arg_names()
+
 
 def _as_list(x):
     if isinstance(x, (list, tuple)):
@@ -286,7 +308,7 @@ def infer_shapes_for_op(op_desc, block_desc):
             if not slot.endswith("@GRAD"):
                 continue
             fwd_slot = slot[: -len("@GRAD")]
-            fwd_names = op_desc.inputs.get(fwd_slot, [])
+            fwd_names = op_desc.input(fwd_slot)
             for gname, fname in zip(names, fwd_names):
                 fv = block_desc.find_var_recursive(fname)
                 gv = block_desc.find_var_recursive(gname)
@@ -389,6 +411,12 @@ class Block:
 
     def all_parameters(self):
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def op_descs(self):
+        """The block's OpDesc list as the desc holds it — authoritative
+        even when transpilers mutated the desc behind the ``ops``
+        wrapper list."""
+        return list(self.desc.ops)
 
 
 class OpRole:

@@ -67,10 +67,20 @@ class LayerHelper:
             )
 
         startup_block = self.startup_program.global_block()
+        # A shared parameter (same ParamAttr name, e.g. word2vec's
+        # "shared_w") is created once per referencing layer; only the
+        # first creation appends an init op, or the startup program would
+        # initialize the var N times (reference: framework.py
+        # Block.create_parameter skips an already-inited param).
+        already_inited = any(
+            attr.name in op.output_arg_names()
+            for op in startup_block.desc.ops
+        )
         sv = startup_block.create_var(
             name=attr.name, shape=shape, dtype=dtype, persistable=True
         )
-        attr.initializer(sv, startup_block)
+        if not already_inited:
+            attr.initializer(sv, startup_block)
 
         param = self.main_program.global_block().create_parameter(
             name=attr.name,

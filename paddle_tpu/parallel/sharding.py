@@ -30,6 +30,11 @@ class ShardingRules:
         self._rules.append((re.compile(pattern), spec))
         return self
 
+    def rules(self):
+        """The ordered (compiled_pattern, spec) pairs — the public view
+        the analysis sharding-consistency pass audits."""
+        return list(self._rules)
+
     def spec_for(self, name, ndim=None):
         for pat, spec in self._rules:
             if pat.search(name):

@@ -15,6 +15,78 @@ from paddle_tpu import dataset
 from paddle_tpu.framework import Program, program_guard
 
 
+# -- model builders (module-level so tools/lint_program.py can lint the
+# same programs these tests train) -------------------------------------
+# Each returns (feed_names, fetch_var, loss_var) and must run inside a
+# program_guard.
+
+def build_fit_a_line():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    return ["x", "y"], y_predict, avg_cost
+
+
+def build_recognize_digits():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                            dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                               act="relu")
+    pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+    pred = fluid.layers.fc(input=pool, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=pred, label=label)
+    return ["img", "label"], pred, fluid.layers.mean(cost)
+
+
+def build_word2vec(dict_size=200):
+    names = ["firstw", "secondw", "thirdw", "forthw", "nextw"]
+    words = [fluid.layers.data(name=n, shape=[1], dtype="int64")
+             for n in names]
+    embeds = [fluid.layers.embedding(
+        input=w, size=[dict_size, 32], dtype="float32",
+        param_attr="shared_w") for w in words[:4]]
+    concat = fluid.layers.concat(input=embeds, axis=1)
+    hidden1 = fluid.layers.fc(input=concat, size=64, act="sigmoid")
+    predict = fluid.layers.fc(input=hidden1, size=dict_size,
+                              act="softmax")
+    cost = fluid.layers.cross_entropy(input=predict, label=words[4])
+    return names, predict, fluid.layers.mean(cost)
+
+
+def build_machine_translation(dict_size=120, seq_len=14):
+    s = fluid.layers.data(name="src", shape=[seq_len], dtype="int64")
+    t = fluid.layers.data(name="trg", shape=[seq_len], dtype="int64")
+    n = fluid.layers.data(name="nxt", shape=[seq_len], dtype="int64")
+    semb = fluid.layers.embedding(input=s, size=[dict_size, 32],
+                                  dtype="float32")
+    # encoder: mean over time of embedded source
+    enc = fluid.layers.reduce_mean(semb, dim=1)
+    temb = fluid.layers.embedding(input=t, size=[dict_size, 32],
+                                  dtype="float32")
+    enc_tiled = fluid.layers.expand(
+        fluid.layers.unsqueeze(enc, axes=[1]),
+        expand_times=[1, seq_len, 1])
+    dec_in = fluid.layers.concat([temb, semb, enc_tiled], axis=2)
+    hidden = fluid.layers.fc(input=dec_in, size=64, act="tanh",
+                             num_flatten_dims=2)
+    logits = fluid.layers.fc(input=hidden, size=dict_size,
+                             num_flatten_dims=2)
+    loss = fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=fluid.layers.unsqueeze(n, axes=[2]))
+    return ["src", "trg", "nxt"], logits, fluid.layers.mean(loss)
+
+
+BOOK_BUILDERS = {
+    "fit_a_line": build_fit_a_line,
+    "recognize_digits": build_recognize_digits,
+    "word2vec": build_word2vec,
+    "machine_translation": build_machine_translation,
+}
+
+
 def _train_save_load(build, batches, feed_fn, save_names, target, tol,
                      max_epochs=8, lr=5e-3):
     """Shared harness: build -> train until loss < tol -> save -> load ->
@@ -62,15 +134,7 @@ def test_fit_a_line():
     batches = [(xs[i:i + 64], ys[i:i + 64])
                for i in range(0, len(xs), 64)]
 
-    def build():
-        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
-        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
-        y_predict = fluid.layers.fc(input=x, size=1, act=None)
-        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
-        avg_cost = fluid.layers.mean(cost)
-        return ["x", "y"], y_predict, avg_cost
-
-    _train_save_load(build, batches,
+    _train_save_load(build_fit_a_line, batches,
                      lambda b: {"x": b[0], "y": b[1]},
                      ["x"], "y_predict", tol=12.0, max_epochs=80,
                      lr=2e-1)
@@ -86,18 +150,7 @@ def test_recognize_digits():
     batches = [(xs[i:i + 64], ys[i:i + 64])
                for i in range(0, len(xs), 64)]
 
-    def build():
-        img = fluid.layers.data(name="img", shape=[1, 28, 28],
-                                dtype="float32")
-        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-        conv = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
-                                   act="relu")
-        pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
-        pred = fluid.layers.fc(input=pool, size=10, act="softmax")
-        cost = fluid.layers.cross_entropy(input=pred, label=label)
-        return ["img", "label"], pred, fluid.layers.mean(cost)
-
-    _train_save_load(build, batches,
+    _train_save_load(build_recognize_digits, batches,
                      lambda b: {"img": b[0], "label": b[1]},
                      ["img"], "pred", tol=0.35, max_epochs=12)
 
@@ -112,18 +165,7 @@ def test_word2vec():
     batches = [arr[i:i + 256] for i in range(0, len(arr), 256)]
 
     def build():
-        names = ["firstw", "secondw", "thirdw", "forthw", "nextw"]
-        words = [fluid.layers.data(name=n, shape=[1], dtype="int64")
-                 for n in names]
-        embeds = [fluid.layers.embedding(
-            input=w, size=[dict_size, 32], dtype="float32",
-            param_attr="shared_w") for w in words[:4]]
-        concat = fluid.layers.concat(input=embeds, axis=1)
-        hidden1 = fluid.layers.fc(input=concat, size=64, act="sigmoid")
-        predict = fluid.layers.fc(input=hidden1, size=dict_size,
-                                  act="softmax")
-        cost = fluid.layers.cross_entropy(input=predict, label=words[4])
-        return names, predict, fluid.layers.mean(cost)
+        return build_word2vec(dict_size)
 
     def feed(b):
         return {n: b[:, i:i + 1]
@@ -161,25 +203,7 @@ def test_machine_translation():
                for i in range(0, len(src), 64)]
 
     def build():
-        s = fluid.layers.data(name="src", shape=[T], dtype="int64")
-        t = fluid.layers.data(name="trg", shape=[T], dtype="int64")
-        n = fluid.layers.data(name="nxt", shape=[T], dtype="int64")
-        semb = fluid.layers.embedding(input=s, size=[DICT, 32],
-                                      dtype="float32")
-        # encoder: mean over time of embedded source
-        enc = fluid.layers.reduce_mean(semb, dim=1)
-        temb = fluid.layers.embedding(input=t, size=[DICT, 32],
-                                      dtype="float32")
-        enc_tiled = fluid.layers.expand(
-            fluid.layers.unsqueeze(enc, axes=[1]), expand_times=[1, T, 1])
-        dec_in = fluid.layers.concat([temb, semb, enc_tiled], axis=2)
-        hidden = fluid.layers.fc(input=dec_in, size=64, act="tanh",
-                                 num_flatten_dims=2)
-        logits = fluid.layers.fc(input=hidden, size=DICT,
-                                 num_flatten_dims=2)
-        loss = fluid.layers.softmax_with_cross_entropy(
-            logits=logits, label=fluid.layers.unsqueeze(n, axes=[2]))
-        return ["src", "trg", "nxt"], logits, fluid.layers.mean(loss)
+        return build_machine_translation(DICT, T)
 
     _train_save_load(
         build, batches,

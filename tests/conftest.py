@@ -19,3 +19,16 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--verify-programs", action="store_true", default=False,
+        help="run the static program verifier (paddle_tpu.analysis) on "
+             "every program the suite compiles (sets PADDLE_TPU_VERIFY=1; "
+             "ERROR-severity findings fail the test)")
+
+
+def pytest_configure(config):
+    if config.getoption("--verify-programs"):
+        os.environ["PADDLE_TPU_VERIFY"] = "1"
