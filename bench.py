@@ -459,6 +459,52 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=30,
     return out
 
 
+def bench_trace_opt(seq_len=128, batch=2):
+    """Trace/compile-time effect of the desc-level transform pipeline
+    (analysis/transforms.py): builds a small *unfused* BERT training
+    program — the composition the fuse-attention pass targets — and
+    reports op counts plus wall time to first compiled step at opt level
+    0 vs 2. Runs on whatever backend is up (the metric is trace-side, so
+    CPU numbers are meaningful too)."""
+    import time
+
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags, models
+    from paddle_tpu.analysis import optimize_program
+
+    main, startup, h = models.bert.get_model(
+        batch_size=batch, seq_len=seq_len, vocab_size=1000, dropout=0.0,
+        lr=1e-4, max_position=max(512, seq_len), d_model=128, n_layers=2,
+        n_heads=2, d_inner=256, use_fused_attention=False)
+    fetch = [h["loss"]]
+    feeds = list(models.bert.make_fake_batch(batch, seq_len, 1000, 2))
+    n_ops0 = len(main.desc.block(0).ops)
+    opt_desc, report = optimize_program(
+        main.desc, level=2, feed_names=feeds, fetch_names=[h["loss"].name])
+    out = {
+        "bert_unfused_ops_opt0": n_ops0,
+        "bert_unfused_ops_opt2": len(opt_desc.block(0).ops),
+        "opt2_rewrites": report.total,
+        "opt2_attention_rewrites": report.rewrites.get("fuse-attention", 0),
+    }
+    b = models.bert.make_fake_batch(batch, seq_len, 1000, 2)
+    b = {k: jax.device_put(v) for k, v in b.items()}
+    for level, key in ((0, "compile_ms_opt0"), (2, "compile_ms_opt2")):
+        flags.set_flags({"opt_level": level})
+        try:
+            exe = fluid.Executor()
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                t0 = time.perf_counter()
+                exe.run(main, feed=b, fetch_list=fetch)
+                out[key] = round((time.perf_counter() - t0) * 1e3, 1)
+        finally:
+            flags.reset_flag("opt_level")
+    return out
+
+
 def main():
     which = os.environ.get("PADDLE_TPU_BENCH", "default")
     result = {
@@ -517,6 +563,11 @@ def main():
             result.update(bench_flash_attention())
         except Exception as e:  # noqa: BLE001
             errors["flash"] = str(e)[:200]
+    if which in ("default", "all", "trace"):
+        try:
+            result.update(bench_trace_opt())
+        except Exception as e:  # noqa: BLE001
+            errors["trace"] = str(e)[:200]
     if which in ("default", "all", "mnist") or result["value"] == 0.0:
         v = _try("mnist", bench_mnist_mlp)
         if v:

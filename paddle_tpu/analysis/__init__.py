@@ -31,10 +31,20 @@ from paddle_tpu.analysis.passes import (  # noqa: F401
     verify_graph,
     verify_program,
 )
+from paddle_tpu.analysis.transforms import (  # noqa: F401
+    TRANSFORM_PIPELINE,
+    TransformContext,
+    TransformPass,
+    TransformReport,
+    optimize_program,
+    transform_passes,
+)
 
 __all__ = [
     "AnalysisContext", "DEFAULT_PASSES", "DiagnosticReport", "Finding",
-    "Graph", "OpNode", "PASS_REGISTRY", "Pass", "Severity", "VarNode",
-    "VerificationError", "build_graph", "default_passes", "register_pass",
-    "run_passes", "verify_graph", "verify_program",
+    "Graph", "OpNode", "PASS_REGISTRY", "Pass", "Severity",
+    "TRANSFORM_PIPELINE", "TransformContext", "TransformPass",
+    "TransformReport", "VarNode", "VerificationError", "build_graph",
+    "default_passes", "optimize_program", "register_pass", "run_passes",
+    "transform_passes", "verify_graph", "verify_program",
 ]

@@ -69,6 +69,9 @@ class Pass:
     """Base checker: ``check(graph, ctx) -> list[Finding]``."""
 
     name = "pass"
+    # "checker" (read-only, returns Findings) or "transform" (mutates a
+    # cloned desc — see transforms.py TransformPass).
+    kind = "checker"
 
     def check(self, graph, ctx):
         raise NotImplementedError

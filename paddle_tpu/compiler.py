@@ -116,7 +116,7 @@ class CompiledProgram:
         return self._mesh
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy,
-             verify=None):
+             verify=None, opt_level=None):
         if not self._is_data_parallel:
             return executor.engine.run_block(
                 self._program.desc, 0, scope,
@@ -128,6 +128,7 @@ class CompiledProgram:
                 seed=getattr(self._program, "random_seed", 0) or 0,
                 amp=getattr(self._program, "_amp", False),
                 verify=verify,
+                opt_level=opt_level,
             )
         mesh = self._get_mesh()
         fetch_names = [
@@ -149,4 +150,5 @@ class CompiledProgram:
             shard_rules=self._shard_rules,
             data_axes=self._data_axes,
             verify=verify,
+            opt_level=opt_level,
         )

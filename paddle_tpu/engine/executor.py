@@ -101,6 +101,7 @@ class Engine:
         accumulate_steps=1,
         remat_segments=0,
         verify=None,
+        opt_level=None,
     ):
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -111,7 +112,8 @@ class Engine:
             is_test, donate_state, amp, accumulate_steps,
             cache_key_extra=cache_key_extra, mesh=mesh,
             shard_rules=shard_rules, data_axes=data_axes,
-            remat_segments=remat_segments, verify=verify)
+            remat_segments=remat_segments, verify=verify,
+            opt_level=opt_level)
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
@@ -193,11 +195,17 @@ class Engine:
                      fetch_list, is_test, donate_state, amp,
                      accumulate_steps, cache_key_extra=None, mesh=None,
                      shard_rules=None, data_axes=("dp",), remat_segments=0,
-                     verify=None):
+                     verify=None, opt_level=None):
         """LRU-cached executable lookup/compile for one (program, feed
         signature) — shared by ``run_block`` and the Executor's
         ``cost_analysis`` so an analysis compiles exactly the executable
         a subsequent run reuses (and vice versa)."""
+        from paddle_tpu import flags
+
+        if opt_level is None:
+            opt_level = int(flags.get_flag("opt_level"))
+        else:
+            opt_level = int(opt_level)
         key = (
             program_desc.cached_fingerprint(),
             block_idx,
@@ -210,27 +218,40 @@ class Engine:
             accumulate_steps,
             remat_segments,
             cache_key_extra,
+            opt_level,
         )
         compiled = self._cache.get(key)
         if compiled is None:
-            if verify is None:
-                from paddle_tpu import flags
+            run_desc = program_desc
+            if opt_level > 0:
+                # Desc-level rewrites, once per compiled executable (cache
+                # misses only). optimize_program works on a clone and
+                # returns the original untouched when nothing fires; the
+                # cache stays keyed on the ORIGINAL desc + opt level, so
+                # differently-optimized executables never alias.
+                from paddle_tpu.analysis.transforms import optimize_program
 
+                run_desc, _report = optimize_program(
+                    program_desc, level=opt_level, feed_names=feed_names,
+                    fetch_names=fetch_list)
+            if verify is None:
                 verify = flags.get_flag("verify")
             if verify:
                 # Pre-lowering static verification, once per executable
                 # (cache misses only — zero steady-state overhead). ERROR
                 # findings raise VerificationError with source-level
-                # coordinates instead of a deep trace-time failure.
+                # coordinates instead of a deep trace-time failure. Runs
+                # on the POST-transform desc: every rewrite the pipeline
+                # produced is itself verified.
                 from paddle_tpu.analysis import verify_program
 
                 verify_program(
-                    program_desc, feed_names=feed_names,
+                    run_desc, feed_names=feed_names,
                     fetch_names=fetch_list, mesh=mesh,
                     shard_rules=shard_rules, data_axes=data_axes,
                     raise_on_error=True)
             compiled = self._compile(
-                program_desc.block(block_idx), feed_names, fetch_list,
+                run_desc.block(block_idx), feed_names, fetch_list,
                 is_test, donate_state, mesh=mesh, feed_values=feed_values,
                 shard_rules=shard_rules, data_axes=data_axes, amp=amp,
                 accumulate_steps=accumulate_steps,

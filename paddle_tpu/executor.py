@@ -133,7 +133,7 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True, accumulate_steps=1, remat_segments=0,
-            verify=None):
+            verify=None, opt_level=None):
         """``accumulate_steps=k`` runs the feed as k micro-batches through a
         compiled scan with one optimizer update on the averaged gradients —
         the batch-merge capability (reference:
@@ -151,7 +151,12 @@ class Executor:
         ``verify=True`` (default: the PADDLE_TPU_VERIFY flag) statically
         verifies the program pre-lowering — once per compiled executable
         — and raises ``analysis.VerificationError`` on ERROR-severity
-        findings (see paddle_tpu.analysis)."""
+        findings (see paddle_tpu.analysis).
+
+        ``opt_level`` (default: the PADDLE_TPU_OPT_LEVEL flag) selects the
+        desc-level transform pipeline applied once per compiled
+        executable — 0 off, 1 attention-pattern→flash rewrite, 2 + fusion
+        / constant folding / CSE (see paddle_tpu.analysis.transforms)."""
         from paddle_tpu.compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()
@@ -164,7 +169,7 @@ class Executor:
                     "(SPMD) path yet; pass the plain Program, or combine "
                     "sharding with accumulate_steps for memory headroom")
             return program._run(self, feed, fetch_list, scope, return_numpy,
-                                verify=verify)
+                                verify=verify, opt_level=opt_level)
 
         if program is None:
             program = default_main_program()
@@ -197,4 +202,5 @@ class Executor:
             accumulate_steps=accumulate_steps,
             remat_segments=remat_segments,
             verify=verify,
+            opt_level=opt_level,
         )

@@ -30,6 +30,14 @@ DEFS = {
         "on ERROR-severity findings (use-before-def, dtype clashes, "
         "orphan gradients, bad sharding axes...). Source-level "
         "diagnostics instead of a deep XLA traceback."),
+    "opt_level": (
+        int, 1,
+        "Desc-level optimization applied once per compiled executable at "
+        "the engine's cache-miss seam (analysis/transforms.py "
+        "optimize_program): 0 = off, 1 = attention-pattern rewrite to "
+        "the fused flash-attention op, 2 = + elementwise+activation "
+        "fusion, constant folding, and CSE. Rewrites operate on a clone; "
+        "the program desc is never mutated."),
     "executable_cache_size": (
         int, 128,
         "LRU capacity of the engine's compiled-executable cache "

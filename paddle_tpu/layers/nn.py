@@ -2437,6 +2437,30 @@ def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,
     return out
 
 
+# Additive mask magnitude: large enough that softmax zeroes the masked
+# keys in every float dtype, small enough not to overflow float16.
+_ATTN_MASK_BIG = 1e9
+
+
+def attention_bias_from_lens(seq_lens, max_len, name=None):
+    """Additive key-padding attention bias [B, 1, 1, max_len] from a
+    per-sequence lengths vector: 0 for valid keys, -1e9 past each
+    sequence's length. The canonical mask emission for the UNFUSED
+    attention composition — built from exactly the ops
+    (sequence_mask → scale → reshape2) the analysis fuse-attention
+    transform pass recognizes, so the lengths vector round-trips into
+    the fused op's ``SeqLens`` input when the rewrite fires. Every
+    intermediate is stop_gradient: the mask is data, not model."""
+    mask = sequence_mask(seq_lens, maxlen=int(max_len))  # [B, T] of 0/1
+    mask.stop_gradient = True
+    bias = scale(mask, scale=_ATTN_MASK_BIG, bias=-_ATTN_MASK_BIG,
+                 name=name)  # 1 -> 0, 0 -> -BIG
+    bias.stop_gradient = True
+    bias = reshape(bias, shape=[-1, 1, 1, int(max_len)])
+    bias.stop_gradient = True
+    return bias
+
+
 def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
               max_depth=2, act="tanh", param_attr=None, bias_attr=None,
               name=None):

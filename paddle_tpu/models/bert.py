@@ -14,7 +14,7 @@ from paddle_tpu.models.transformer import (
 def bert_encoder(src_ids, pos_ids, sent_ids, seq_lens, vocab_size,
                  max_position=512, type_vocab_size=2, d_model=768,
                  n_layers=12, n_heads=12, d_inner=3072, dropout=0.1,
-                 is_train=True):
+                 is_train=True, use_fused_attention=True):
     word = fluid.layers.embedding(
         input=src_ids, size=[vocab_size, d_model],
         param_attr=fluid.ParamAttr(name="word_embedding"))
@@ -34,8 +34,9 @@ def bert_encoder(src_ids, pos_ids, sent_ids, seq_lens, vocab_size,
 
     h = emb
     for _ in range(n_layers):
-        attn = multi_head_attention(h, h, h, d_model, n_heads, dropout,
-                                    seq_lens=seq_lens, is_train=is_train)
+        attn = multi_head_attention(
+            h, h, h, d_model, n_heads, dropout, seq_lens=seq_lens,
+            is_train=is_train, use_fused_attention=use_fused_attention)
         h = pre_post_process(h, attn, dropout, is_train)
         f = ffn(h, d_model, d_inner, is_train, act="gelu")
         h = pre_post_process(h, f, dropout, is_train)
@@ -79,7 +80,7 @@ def pretrain_heads(enc_out, mask_label, mask_weight, ns_label, vocab_size,
 
 def get_model(batch_size=8, seq_len=128, vocab_size=30522, d_model=768,
               n_layers=12, n_heads=12, d_inner=3072, dropout=0.1, lr=1e-4,
-              is_train=True, max_position=512):
+              is_train=True, max_position=512, use_fused_attention=True):
     """BERT pre-training program. ``bert_base`` defaults; shrink the dims for
     tests."""
     main = fluid.Program()
@@ -103,7 +104,8 @@ def get_model(batch_size=8, seq_len=128, vocab_size=30522, d_model=768,
                            max_position=max_position, d_model=d_model,
                            n_layers=n_layers, n_heads=n_heads,
                            d_inner=d_inner, dropout=dropout,
-                           is_train=is_train)
+                           is_train=is_train,
+                           use_fused_attention=use_fused_attention)
         loss, mlm_loss, ns_loss = pretrain_heads(
             enc, mask_label, mask_weight, ns_label, vocab_size, d_model,
             is_train=is_train)

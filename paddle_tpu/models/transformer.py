@@ -16,7 +16,10 @@ import numpy as np
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.initializer import NumpyArrayInitializer
-from paddle_tpu.layers.nn import fused_attention as _fused_attention_layer
+from paddle_tpu.layers.nn import (
+    attention_bias_from_lens as _attention_bias_from_lens,
+    fused_attention as _fused_attention_layer,
+)
 
 
 def positional_encoding_table(max_len, d_model):
@@ -32,7 +35,8 @@ def positional_encoding_table(max_len, d_model):
 def multi_head_attention(q_in, k_in, v_in, d_model, n_heads, dropout_rate,
                          mask=None, seq_lens=None, causal=False,
                          is_train=True, name=None,
-                         sequence_parallel=False, sp_axis="sp"):
+                         sequence_parallel=False, sp_axis="sp",
+                         use_fused_attention=True):
     """Scaled dot-product attention with head split/merge
     (reference: dist_transformer.py multi_head_attention).
 
@@ -42,7 +46,15 @@ def multi_head_attention(q_in, k_in, v_in, d_model, n_heads, dropout_rate,
     forces the unfused composition. ``sequence_parallel=True`` shards the
     sequence axis over the mesh's ``sp_axis`` and runs exact ring
     attention (parallel/ring_attention.py) — the long-context path; it
-    requires dropout 0 and no seq_lens/mask."""
+    requires dropout 0 and no seq_lens/mask.
+
+    ``use_fused_attention=False`` emits the reference-style unfused
+    composition (matmul→[+mask]→softmax→[dropout]→matmul) with seq_lens
+    expressed as the additive bias from
+    ``layers.nn.attention_bias_from_lens`` — the form the
+    ``fuse-attention`` transform pass (analysis/transforms.py) rewrites
+    back to the fused op at PADDLE_TPU_OPT_LEVEL>=1. Causal attention has
+    no unfused emission and stays on the fused op regardless."""
     d_head = d_model // n_heads
     q = fluid.layers.fc(input=q_in, size=d_model, num_flatten_dims=2,
                         bias_attr=False)
@@ -71,15 +83,18 @@ def multi_head_attention(q_in, k_in, v_in, d_model, n_heads, dropout_rate,
         ctx = _fused_attention_layer(
             q, k, v, causal=causal, scale=d_head ** -0.5,
             dropout_rate=0.0, sequence_parallel=True, sp_axis=sp_axis)
-    elif mask is None:
+    elif mask is None and (use_fused_attention or causal):
         ctx = _fused_attention_layer(
             q, k, v, causal=causal, scale=d_head ** -0.5,
             seq_lens=seq_lens,
             dropout_rate=dropout_rate if is_train else 0.0)
     else:
+        if mask is None and seq_lens is not None:
+            mask = _attention_bias_from_lens(seq_lens, k.shape[2])
         scores = fluid.layers.matmul(q, k, transpose_y=True,
                                      alpha=d_head ** -0.5)
-        scores = fluid.layers.elementwise_add(scores, mask)
+        if mask is not None:
+            scores = fluid.layers.elementwise_add(scores, mask)
         weights = fluid.layers.softmax(scores)
         if dropout_rate > 0:
             weights = fluid.layers.dropout(
@@ -108,23 +123,26 @@ def pre_post_process(prev, out, dropout_rate, is_train):
     return fluid.layers.layer_norm(out, begin_norm_axis=2)
 
 
-def encoder_layer(x, d_model, n_heads, d_inner, dropout, src_lens, is_train):
+def encoder_layer(x, d_model, n_heads, d_inner, dropout, src_lens, is_train,
+                  use_fused_attention=True):
     attn = multi_head_attention(x, x, x, d_model, n_heads, dropout,
-                                seq_lens=src_lens, is_train=is_train)
+                                seq_lens=src_lens, is_train=is_train,
+                                use_fused_attention=use_fused_attention)
     x = pre_post_process(x, attn, dropout, is_train)
     f = ffn(x, d_model, d_inner, is_train)
     return pre_post_process(x, f, dropout, is_train)
 
 
 def decoder_layer(x, enc_out, d_model, n_heads, d_inner, dropout,
-                  trg_lens, src_lens, is_train):
+                  trg_lens, src_lens, is_train, use_fused_attention=True):
     self_attn = multi_head_attention(x, x, x, d_model, n_heads, dropout,
                                      seq_lens=trg_lens, causal=True,
                                      is_train=is_train)
     x = pre_post_process(x, self_attn, dropout, is_train)
     cross = multi_head_attention(x, enc_out, enc_out, d_model, n_heads,
                                  dropout, seq_lens=src_lens,
-                                 is_train=is_train)
+                                 is_train=is_train,
+                                 use_fused_attention=use_fused_attention)
     x = pre_post_process(x, cross, dropout, is_train)
     f = ffn(x, d_model, d_inner, is_train)
     return pre_post_process(x, f, dropout, is_train)
@@ -149,16 +167,18 @@ def build_transformer(src_ids, src_pos, trg_ids, trg_pos, label,
                       src_lens, trg_lens,
                       vocab_size, d_model=256, n_heads=8, d_inner=1024,
                       n_layers=4, dropout=0.1, max_len=256, is_train=True,
-                      label_smooth_eps=0.1):
+                      label_smooth_eps=0.1, use_fused_attention=True):
     enc = embed(src_ids, vocab_size, d_model, max_len, src_pos, "src")
     for _ in range(n_layers):
         enc = encoder_layer(enc, d_model, n_heads, d_inner, dropout,
-                            src_lens, is_train)
+                            src_lens, is_train,
+                            use_fused_attention=use_fused_attention)
 
     dec = embed(trg_ids, vocab_size, d_model, max_len, trg_pos, "trg")
     for _ in range(n_layers):
         dec = decoder_layer(dec, enc, d_model, n_heads, d_inner, dropout,
-                            trg_lens, src_lens, is_train)
+                            trg_lens, src_lens, is_train,
+                            use_fused_attention=use_fused_attention)
 
     logits = fluid.layers.fc(input=dec, size=vocab_size, num_flatten_dims=2,
                              act=None)
@@ -179,7 +199,8 @@ def build_transformer(src_ids, src_pos, trg_ids, trg_pos, label,
 
 def get_model(batch_size=8, seq_len=16, vocab_size=1000, d_model=64,
               n_heads=4, d_inner=128, n_layers=2, dropout=0.1, lr=1e-3,
-              is_train=True, label_smooth_eps=0.1):
+              is_train=True, label_smooth_eps=0.1,
+              use_fused_attention=True):
     """Feeds: src/trg token ids + position ids + per-sequence valid
     lengths (key-padding masks, TPU-first: no dense [B,H,T,T] mask
     tensors; the decoder's causal mask is structural)."""
@@ -202,7 +223,8 @@ def get_model(batch_size=8, seq_len=16, vocab_size=1000, d_model=64,
             src, src_pos, trg, trg_pos, label, src_lens, trg_lens,
             vocab_size, d_model, n_heads, d_inner, n_layers,
             dropout, max_len=max(seq_len, 256), is_train=is_train,
-            label_smooth_eps=label_smooth_eps)
+            label_smooth_eps=label_smooth_eps,
+            use_fused_attention=use_fused_attention)
         if is_train:
             fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
     feeds = {"src": src, "src_pos": src_pos, "trg": trg, "trg_pos": trg_pos,

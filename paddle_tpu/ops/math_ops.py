@@ -186,6 +186,28 @@ register_op("elementwise_mod", grad=None)(_elementwise(jnp.mod))
 register_op("elementwise_floordiv", grad=None)(_elementwise(jnp.floor_divide))
 
 
+@register_op("fused_elemwise_activation")
+def fused_elemwise_activation(ctx, ins, attrs):
+    """Binary elementwise op + unary activation in one op (reference:
+    operators/fused/fused_elemwise_activation_op.cc, attr
+    ``functor_list`` = [binary, unary]). Emitted by the
+    fuse-elemwise-act transform pass (analysis/transforms.py) — the
+    lowering delegates to the REGISTERED component lowerings, so fused
+    and unfused programs compute bit-identical values."""
+    from paddle_tpu.core.registry import OpRegistry
+
+    functors = list(attrs.get("functor_list", ()))
+    if len(functors) != 2:
+        raise ValueError(
+            "fused_elemwise_activation needs functor_list=[binary, "
+            "unary], got %r" % (functors,))
+    binary, unary = functors
+    mid = OpRegistry.get(binary).lower(
+        ctx, {"X": ins.get("X", []), "Y": ins.get("Y", [])},
+        {"axis": attrs.get("axis", -1)})["Out"]
+    return OpRegistry.get(unary).lower(ctx, {"X": mid}, attrs)
+
+
 @register_op("scale")
 def scale(ctx, ins, attrs):
     from paddle_tpu.core.selected_rows import SelectedRows

@@ -25,10 +25,16 @@ def pytest_addoption(parser):
     parser.addoption(
         "--verify-programs", action="store_true", default=False,
         help="run the static program verifier (paddle_tpu.analysis) on "
-             "every program the suite compiles (sets PADDLE_TPU_VERIFY=1; "
+             "every program the suite compiles (sets PADDLE_TPU_VERIFY=1 "
+             "and, unless PADDLE_TPU_OPT_LEVEL is already set, opt level 2 "
+             "so the verifier sees the post-transform descs; "
              "ERROR-severity findings fail the test)")
 
 
 def pytest_configure(config):
     if config.getoption("--verify-programs"):
         os.environ["PADDLE_TPU_VERIFY"] = "1"
+        # The engine verifies the desc it actually compiles — the
+        # post-transform clone — so running the suite at level 2
+        # re-verifies every transformed program suite-wide.
+        os.environ.setdefault("PADDLE_TPU_OPT_LEVEL", "2")

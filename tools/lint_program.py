@@ -12,12 +12,16 @@ Two modes:
     verify main + startup programs with the real feed/fetch lists.
 
 All six checkers run (use-before-def, shape-dtype, waw-hazard,
-grad-pairing, dead-op, sharding). Exit code 1 iff any ERROR finding.
+grad-pairing, dead-op, sharding). ``--opt-level N`` first runs the
+transform pipeline (analysis/transforms.py) over each program and lints
+the *transformed* desc — the same desc the engine would compile at that
+level. Exit code 1 iff any ERROR finding.
 
   python tools/lint_program.py
+  python tools/lint_program.py --list-passes
   python tools/lint_program.py --model fit_a_line --model word2vec -v
   python tools/lint_program.py --mesh dp=4,tp=2 --rule '.*fc.*w:,tp'
-  python tools/lint_program.py --program /tmp/main.prog
+  python tools/lint_program.py --program /tmp/main.prog --opt-level 2
 """
 
 import argparse
@@ -88,6 +92,41 @@ def _parse_rules(rule_args):
     return rules
 
 
+def _list_passes():
+    """Every registered pass: name, kind (checker/transform), and whether
+    it runs by default — checkers iff in DEFAULT_PASSES, transforms iff
+    enabled at the opt_level flag's default value."""
+    from paddle_tpu import flags
+    from paddle_tpu.analysis.passes import DEFAULT_PASSES, PASS_REGISTRY
+
+    default_level = flags.DEFS["opt_level"][1]
+    print("%-22s %-10s %s" % ("pass", "kind", "default"))
+    for name in sorted(PASS_REGISTRY):
+        cls = PASS_REGISTRY[name]
+        kind = getattr(cls, "kind", "checker")
+        if kind == "transform":
+            on = getattr(cls, "min_level", 2) <= default_level
+            note = "on (level>=%d)" % cls.min_level if on else \
+                "off (level>=%d)" % cls.min_level
+        else:
+            note = "on" if name in DEFAULT_PASSES else "off"
+        print("%-22s %-10s %s" % (name, kind, note))
+
+
+def _maybe_optimize(program, args, feed_names=None, fetch_names=None):
+    """Apply the transform pipeline when --opt-level was given; returns
+    the desc to lint (the transformed clone, or the input unchanged)."""
+    if args.opt_level is None:
+        return program
+    from paddle_tpu.analysis import optimize_program
+
+    desc, report = optimize_program(
+        program, level=args.opt_level,
+        feed_names=feed_names, fetch_names=fetch_names)
+    print(report.render())
+    return desc
+
+
 def _lint_built_model(name, builder, args):
     from paddle_tpu import unique_name
     from paddle_tpu.analysis import Severity, verify_program
@@ -104,9 +143,13 @@ def _lint_built_model(name, builder, args):
                 fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
         mesh = _parse_mesh(args.mesh)
         rules = _parse_rules(args.rule)
+        fetches = [loss.name, fetch.name]
+        print("== %s ==" % name)
+        main_desc = _maybe_optimize(main, args, feed_names=feeds,
+                                    fetch_names=fetches)
         report = verify_program(
-            main, feed_names=feeds,
-            fetch_names=[loss.name, fetch.name],
+            main_desc, feed_names=feeds,
+            fetch_names=fetches,
             mesh=mesh, shard_rules=rules)
         startup_report = verify_program(startup)
         report.extend(startup_report.findings)
@@ -114,7 +157,6 @@ def _lint_built_model(name, builder, args):
         unique_name.switch(old_gen)
 
     min_sev = Severity.INFO if args.verbose else Severity.WARNING
-    print("== %s ==" % name)
     print(report.render(min_severity=min_sev))
     return report
 
@@ -137,10 +179,11 @@ def _lint_file(path, args):
             program = obj
         else:
             program = obj  # a pickled Program
+    print("== %s ==" % path)
+    program = _maybe_optimize(program, args)
     report = verify_program(program, mesh=_parse_mesh(args.mesh),
                             shard_rules=_parse_rules(args.rule))
     min_sev = Severity.INFO if args.verbose else Severity.WARNING
-    print("== %s ==" % path)
     print(report.render(min_severity=min_sev))
     return report
 
@@ -162,9 +205,21 @@ def main(argv=None):
     parser.add_argument("--rule", action="append", default=[],
                         help="sharding rule PATTERN:axis0,axis1 "
                              "(repeatable; empty slot = unsharded dim)")
+    parser.add_argument("--opt-level", type=int, default=None,
+                        metavar="N",
+                        help="run the transform pipeline at level N and "
+                             "lint the transformed desc (0 off, 1 "
+                             "fuse-attention, 2 + fusion/folding/cse)")
+    parser.add_argument("--list-passes", action="store_true",
+                        help="list every registered pass (name, kind, "
+                             "default on/off) and exit")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="show INFO findings too")
     args = parser.parse_args(argv)
+
+    if args.list_passes:
+        _list_passes()
+        return 0
 
     reports = []
     if args.program:

@@ -33,6 +33,14 @@ def run_marginal_protocol(variants, args, reps):
         jax.device_get(fn_lo(*args))        # compile + warm
         jax.device_get(fn_hi(*args))
         wall[key] = ([], [])
+    # One untimed interleaved round before timing starts: the first
+    # *interleaved* dispatch after the compile loop still eats stragglers
+    # (host-side caching, allocator growth), which otherwise lands in
+    # rep 0 of whichever variant runs first — observed as a 65.5ms
+    # flash_attn_bwd_ms spread against a 3.4ms median.
+    for key, (fn_lo, _, fn_hi, _) in variants.items():
+        jax.device_get(fn_lo(*args))
+        jax.device_get(fn_hi(*args))
     for _ in range(reps):
         for key, (fn_lo, _, fn_hi, _) in variants.items():
             for which, fn in ((0, fn_lo), (1, fn_hi)):
