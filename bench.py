@@ -506,6 +506,14 @@ def bench_trace_opt(seq_len=128, batch=2):
 
 
 def main():
+    from paddle_tpu import flags, observability
+
+    # Telemetry rides along with every bench: the emitted JSON carries a
+    # "counters" object (compile wall, cache hit/miss, transform fires)
+    # so BENCH_*.json tracks the compile-time trajectory across rounds,
+    # not just throughput. Near-zero in-loop cost (counter bumps at the
+    # step seam, ~us against ms-scale steps).
+    flags.set_flags({"metrics": True})
     which = os.environ.get("PADDLE_TPU_BENCH", "default")
     result = {
         "metric": "resnet50_train_images_per_sec",
@@ -579,6 +587,27 @@ def main():
                 result["metric"] = "diag_mnist_mlp_train_examples_per_sec"
                 result["unit"] = "examples/sec"
                 result["value"] = v
+    snap = observability.snapshot()
+    c = snap["counters"]
+    compile_h = snap["histograms"].get("engine.compile_ms", {})
+    trace_h = snap["histograms"].get("engine.trace_ms", {})
+    result["counters"] = {
+        # first-call XLA compile + cache-miss build walls, summed over
+        # every executable the run compiled
+        "compile_wall_ms": round((compile_h.get("total") or 0.0)
+                                 + (trace_h.get("total") or 0.0), 1),
+        "executables_compiled": compile_h.get("count", 0),
+        "cache_hits": c.get("engine.cache_hit", 0),
+        "cache_misses": c.get("engine.cache_miss", 0),
+        "cache_evictions": c.get("engine.cache_evict", 0),
+        "transform_rewrites": {
+            k[len("transform."):-len(".rewrites")]: v
+            for k, v in sorted(c.items())
+            if k.startswith("transform.") and k.endswith(".rewrites")
+            and k != "transform.rewrites"},
+        "transform_rewrites_total": c.get("transform.rewrites", 0),
+        "nan_inf_trips": c.get("engine.nan_inf_trips", 0),
+    }
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

@@ -117,9 +117,15 @@ def run_passes(graph, ctx=None, passes=None):
     of taking down the run it was guarding."""
     ctx = ctx or AnalysisContext()
     report = DiagnosticReport()
+    # Lazy import keeps paddle_tpu.analysis importable standalone;
+    # per-checker wall time lands in the telemetry registry
+    # (tools/lint_program.py --timing prints it).
+    from paddle_tpu import observability as obs
+
     for p in (passes if passes is not None else default_passes()):
         try:
-            report.extend(p.check(graph, ctx))
+            with obs.time_block("analysis.%s.ms" % p.name):
+                report.extend(p.check(graph, ctx))
         except Exception as e:  # pragma: no cover - checker bug guard
             report.add(Finding(
                 Severity.WARNING, p.name,

@@ -149,23 +149,35 @@ def optimize_program(program_or_desc, level=None, feed_names=None,
     report = TransformReport(level)
     if level <= 0 or not selected:
         return desc, report
+    # Lazy import: analysis stays importable without the full package
+    # chain; observability pulls paddle_tpu.flags.
+    from paddle_tpu import observability as obs
+
     ctx = TransformContext(feed_names=feed_names, fetch_names=fetch_names,
                            level=level)
-    good = desc.clone()
-    for p in selected:
-        work = good.clone()
-        try:
-            n = int(p.apply(work, ctx) or 0)
-        except Exception as e:  # discard the half-mutated clone
-            report.crashed[p.name] = "%s: %s" % (type(e).__name__, e)
-            continue
-        if n:
-            good = work
-            report.rewrites[p.name] = report.rewrites.get(p.name, 0) + n
-    if not report.total:
-        return desc, report
-    if ctx.fetch_names:
-        report.pruned = _prune_dead_ops(good, ctx.fetch_names)
+    with obs.span("transform", level=level), \
+            obs.time_block("transform.pipeline_ms"):
+        good = desc.clone()
+        for p in selected:
+            work = good.clone()
+            try:
+                with obs.span("transform:%s" % p.name), \
+                        obs.time_block("transform.%s.ms" % p.name):
+                    n = int(p.apply(work, ctx) or 0)
+            except Exception as e:  # discard the half-mutated clone
+                report.crashed[p.name] = "%s: %s" % (type(e).__name__, e)
+                obs.inc("transform.%s.crashes" % p.name)
+                continue
+            if n:
+                good = work
+                report.rewrites[p.name] = report.rewrites.get(p.name, 0) + n
+                obs.inc("transform.%s.rewrites" % p.name, n)
+                obs.inc("transform.rewrites", n)
+        if not report.total:
+            return desc, report
+        if ctx.fetch_names:
+            report.pruned = _prune_dead_ops(good, ctx.fetch_names)
+            obs.inc("transform.pruned_ops", report.pruned)
     return good, report
 
 

@@ -117,6 +117,15 @@ class CompiledProgram:
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy,
              verify=None, opt_level=None):
+        from paddle_tpu import observability as obs
+
+        with obs.span("compiled_program.run",
+                      spmd=bool(self._is_data_parallel)):
+            return self._run_dispatch(executor, feed, fetch_list, scope,
+                                      return_numpy, verify, opt_level)
+
+    def _run_dispatch(self, executor, feed, fetch_list, scope, return_numpy,
+                      verify=None, opt_level=None):
         if not self._is_data_parallel:
             return executor.engine.run_block(
                 self._program.desc, 0, scope,

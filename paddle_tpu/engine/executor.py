@@ -13,6 +13,7 @@ import numpy as np
 
 import jax
 
+from paddle_tpu import observability as obs
 from paddle_tpu.core.types import convert_dtype_to_np
 from paddle_tpu.engine.lowering import BlockProgram, lower_block
 
@@ -46,6 +47,10 @@ class CompiledBlock:
                  in_shardings=None):
         self.block_program = block_program
         self.jitted = jitted
+        # executions so far: 0 means the next jitted call pays the XLA
+        # compile (jax.jit compiles lazily) — telemetry books that call
+        # as "compile", later ones as "run"
+        self.run_count = 0
         # state vars both read and re-emitted -> donated to XLA (functional
         # form of the reference's in-place ParamOut/MomentOut updates)
         self.mutated_names = mutated_names
@@ -82,7 +87,15 @@ class Engine:
         self.check_nan_inf = bool(flags.get_flag("check_nan_inf"))
 
     # -- public ------------------------------------------------------------
-    def run_block(
+    def run_block(self, program_desc, block_idx, scope, **kwargs):
+        """One engine step, wrapped in the telemetry step span (a no-op
+        ctx mgr when PADDLE_TPU_METRICS is down)."""
+        with obs.span("step", step=self._run_counter + 1), \
+                obs.time_block("engine.step_ms"):
+            return self._run_block_impl(program_desc, block_idx, scope,
+                                        **kwargs)
+
+    def _run_block_impl(
         self,
         program_desc,
         block_idx,
@@ -107,6 +120,9 @@ class Engine:
         fetch_list = fetch_list or []
         block = program_desc.block(block_idx)
         feed_names, feed_values = self._coerce_feed(block, feed)
+        if obs.enabled():
+            obs.inc("engine.feed_bytes",
+                    sum(int(getattr(v, "nbytes", 0)) for v in feed_values))
         compiled = self.get_compiled(
             program_desc, block_idx, feed_names, feed_values, fetch_list,
             is_test, donate_state, amp, accumulate_steps,
@@ -154,13 +170,25 @@ class Engine:
         # the round-1 MNIST bottleneck).
         rng_seed = (np.uint32(seed), np.uint32(self._run_counter))
 
-        fetches, state_out = compiled.jitted(feed_values, mutated, readonly,
-                                             rng_seed)
+        # jax.jit compiles on the executable's FIRST call — telemetry
+        # books that wall as "compile" (the honest XLA-compile time the
+        # cache-miss build above does not see), later calls as "run"
+        # (async dispatch wall).
+        first = compiled.run_count == 0
+        with obs.span("compile" if first else "run",
+                      step=self._run_counter), \
+                obs.time_block("engine.compile_ms" if first
+                               else "engine.run_ms"):
+            fetches, state_out = compiled.jitted(feed_values, mutated,
+                                                 readonly, rng_seed)
+        compiled.run_count += 1
 
         if self.check_nan_inf:
             _check_finite(
-                zip(compiled.block_program.state_out_names, state_out))
-            _check_finite(zip(fetch_list, fetches))
+                zip(compiled.block_program.state_out_names, state_out),
+                step=self._run_counter, kind="state")
+            _check_finite(zip(fetch_list, fetches),
+                          step=self._run_counter, kind="fetch")
 
         for name, val in zip(compiled.block_program.state_out_names, state_out):
             scope.set(name, val)
@@ -168,8 +196,13 @@ class Engine:
         if return_numpy:
             # one batched host transfer for all fetches (device_get on the
             # list) — per-value np.asarray syncs serially
-            return list(jax.device_get(list(fetches)))
-        return list(fetches)
+            fetches = list(jax.device_get(list(fetches)))
+        else:
+            fetches = list(fetches)
+        if obs.enabled():
+            obs.inc("engine.fetch_bytes",
+                    sum(int(getattr(v, "nbytes", 0)) for v in fetches))
+        return fetches
 
     @staticmethod
     def _coerce_feed(block, feed):
@@ -222,46 +255,58 @@ class Engine:
         )
         compiled = self._cache.get(key)
         if compiled is None:
-            run_desc = program_desc
-            if opt_level > 0:
-                # Desc-level rewrites, once per compiled executable (cache
-                # misses only). optimize_program works on a clone and
-                # returns the original untouched when nothing fires; the
-                # cache stays keyed on the ORIGINAL desc + opt level, so
-                # differently-optimized executables never alias.
-                from paddle_tpu.analysis.transforms import optimize_program
+            obs.inc("engine.cache_miss")
+            with obs.span("trace", block=block_idx, opt_level=opt_level), \
+                    obs.time_block("engine.trace_ms"):
+                run_desc = program_desc
+                if opt_level > 0:
+                    # Desc-level rewrites, once per compiled executable
+                    # (cache misses only). optimize_program works on a
+                    # clone and returns the original untouched when
+                    # nothing fires; the cache stays keyed on the
+                    # ORIGINAL desc + opt level, so differently-optimized
+                    # executables never alias.
+                    from paddle_tpu.analysis.transforms import (
+                        optimize_program)
 
-                run_desc, _report = optimize_program(
-                    program_desc, level=opt_level, feed_names=feed_names,
-                    fetch_names=fetch_list)
-            if verify is None:
-                verify = flags.get_flag("verify")
-            if verify:
-                # Pre-lowering static verification, once per executable
-                # (cache misses only — zero steady-state overhead). ERROR
-                # findings raise VerificationError with source-level
-                # coordinates instead of a deep trace-time failure. Runs
-                # on the POST-transform desc: every rewrite the pipeline
-                # produced is itself verified.
-                from paddle_tpu.analysis import verify_program
+                    run_desc, _report = optimize_program(
+                        program_desc, level=opt_level,
+                        feed_names=feed_names, fetch_names=fetch_list)
+                if verify is None:
+                    verify = flags.get_flag("verify")
+                if verify:
+                    # Pre-lowering static verification, once per
+                    # executable (cache misses only — zero steady-state
+                    # overhead). ERROR findings raise VerificationError
+                    # with source-level coordinates instead of a deep
+                    # trace-time failure. Runs on the POST-transform
+                    # desc: every rewrite the pipeline produced is
+                    # itself verified.
+                    from paddle_tpu.analysis import verify_program
 
-                verify_program(
-                    run_desc, feed_names=feed_names,
-                    fetch_names=fetch_list, mesh=mesh,
-                    shard_rules=shard_rules, data_axes=data_axes,
-                    raise_on_error=True)
-            compiled = self._compile(
-                run_desc.block(block_idx), feed_names, fetch_list,
-                is_test, donate_state, mesh=mesh, feed_values=feed_values,
-                shard_rules=shard_rules, data_axes=data_axes, amp=amp,
-                accumulate_steps=accumulate_steps,
-                remat_segments=remat_segments,
-            )
+                    with obs.span("verify"), \
+                            obs.time_block("engine.verify_ms"):
+                        verify_program(
+                            run_desc, feed_names=feed_names,
+                            fetch_names=fetch_list, mesh=mesh,
+                            shard_rules=shard_rules, data_axes=data_axes,
+                            raise_on_error=True)
+                with obs.span("lower"), obs.time_block("engine.lower_ms"):
+                    compiled = self._compile(
+                        run_desc.block(block_idx), feed_names, fetch_list,
+                        is_test, donate_state, mesh=mesh,
+                        feed_values=feed_values, shard_rules=shard_rules,
+                        data_axes=data_axes, amp=amp,
+                        accumulate_steps=accumulate_steps,
+                        remat_segments=remat_segments,
+                    )
             self._cache[key] = compiled
             while len(self._cache) > self._cache_capacity:
                 self._cache.popitem(last=False)
+                obs.inc("engine.cache_evict")
         else:
             self._cache.move_to_end(key)
+            obs.inc("engine.cache_hit")
         return compiled
 
     @staticmethod
@@ -405,10 +450,13 @@ class Engine:
                              in_shardings=in_sh)
 
 
-def _check_finite(named_values):
-    """Raise naming the first non-finite float tensor (reference error
+def _check_finite(named_values, step=None, kind="tensor"):
+    """Raise naming the FIRST non-finite float tensor with its shape,
+    dtype, nan/inf breakdown, and the step counter (reference error
     contract: operator.cc:976 'Operator %s output Tensor %s contains Inf'
-    — here at step granularity)."""
+    — here at step granularity). The trip is recorded as an
+    observability event + counter before raising, so a telemetry
+    snapshot from a crashed run still shows what blew up and when."""
     import jax.numpy as jnp
 
     for name, val in named_values:
@@ -416,7 +464,16 @@ def _check_finite(named_values):
                 jnp.asarray(val).dtype, jnp.floating):
             continue
         if not bool(jnp.isfinite(val).all()):
+            arr = jnp.asarray(val)
+            n_nan = int(jnp.isnan(arr).sum())
+            n_inf = int(jnp.isinf(arr).sum())
+            obs.inc("engine.nan_inf_trips")
+            obs.event("nan_inf_trip", var=name, kind=kind,
+                      shape=str(tuple(arr.shape)), dtype=str(arr.dtype),
+                      step=step, nan=n_nan, inf=n_inf)
             raise RuntimeError(
-                "check_nan_inf: tensor %r contains NaN or Inf after this "
-                "step (reference: FLAGS_check_nan_inf, "
-                "framework/operator.cc:972)" % name)
+                "check_nan_inf: %s %r (shape %s, dtype %s) contains "
+                "%d NaN / %d Inf value(s) after step %s (reference: "
+                "FLAGS_check_nan_inf, framework/operator.cc:972)"
+                % (kind, name, tuple(arr.shape), arr.dtype, n_nan, n_inf,
+                   "?" if step is None else step))

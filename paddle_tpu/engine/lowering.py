@@ -149,11 +149,19 @@ def _op_needs_rng(op):
 def lower_block(block_program, is_test=False, executor=None, amp=False):
     """Returns fn(feeds: list, state_in: list, rng_key) ->
     (fetches: list, state_out: list)."""
+    from paddle_tpu import observability as obs
     from paddle_tpu.core.registry import amp_scope
 
     block = block_program.block
     feed_names = block_program.feed_names
     state_in_names = block_program.state_in_names
+    if obs.enabled():
+        # op counts of what actually lowers (post-DCE) vs the raw block —
+        # the trace-size numbers the transform pipeline moves
+        obs.observe("lower.ops", len(block_program.ops))
+        obs.observe("lower.block_ops",
+                    len([o for o in block.ops if o.type not in _SKIP_OPS]))
+        obs.inc("lower.blocks")
 
     def fn(feed_values, state_values, rng_key):
         env = {}

@@ -156,7 +156,25 @@ class Executor:
         ``opt_level`` (default: the PADDLE_TPU_OPT_LEVEL flag) selects the
         desc-level transform pipeline applied once per compiled
         executable — 0 off, 1 attention-pattern→flash rewrite, 2 + fusion
-        / constant folding / CSE (see paddle_tpu.analysis.transforms)."""
+        / constant folding / CSE (see paddle_tpu.analysis.transforms).
+
+        Every run is wrapped in a top-level ``executor.run`` telemetry
+        span when ``PADDLE_TPU_METRICS`` is up (paddle_tpu.observability)
+        — the outermost host lane of the step timeline."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.compiler import CompiledProgram
+
+        with obs.span("executor.run"):
+            return self._run_impl(
+                program=program, feed=feed, fetch_list=fetch_list,
+                scope=scope, return_numpy=return_numpy,
+                accumulate_steps=accumulate_steps,
+                remat_segments=remat_segments, verify=verify,
+                opt_level=opt_level)
+
+    def _run_impl(self, program=None, feed=None, fetch_list=None,
+                  scope=None, return_numpy=True, accumulate_steps=1,
+                  remat_segments=0, verify=None, opt_level=None):
         from paddle_tpu.compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()

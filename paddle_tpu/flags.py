@@ -15,7 +15,7 @@ Usage, mirroring the reference's fluid.core.globals-style access::
 import os
 
 __all__ = ["DEFS", "get_flag", "set_flags", "reset_flag", "describe",
-           "env_name"]
+           "env_name", "on_change"]
 
 # name -> (type, default, help)
 DEFS = {
@@ -68,10 +68,30 @@ DEFS = {
     "trace_dir": (
         str, "",
         "Profiler trace output directory (profiler.py)."),
+    "metrics": (
+        bool, False,
+        "Runtime telemetry (paddle_tpu.observability): engine "
+        "cache/compile/run counters + timing histograms and host-side "
+        "spans exportable as chrome-trace JSON. Off = no-op stubs at "
+        "every instrumented seam (near-zero overhead)."),
 }
 
 _overrides = {}
 _env_backup = {}
+# name -> [callables] invoked with the new value after set_flags /
+# reset_flag touches that flag (observability caches its gate off this).
+_change_hooks = {}
+
+
+def on_change(name, fn):
+    if name not in DEFS:
+        raise KeyError("unknown flag %r" % name)
+    _change_hooks.setdefault(name, []).append(fn)
+
+
+def _notify(name):
+    for fn in _change_hooks.get(name, ()):
+        fn(get_flag(name))
 
 
 def env_name(name):
@@ -109,6 +129,7 @@ def set_flags(flags_dict):
         _overrides[name] = value
         os.environ[env_name(name)] = (
             ("1" if value else "0") if typ is bool else str(value))
+        _notify(name)
 
 
 def reset_flag(name):
@@ -120,6 +141,7 @@ def reset_flag(name):
         os.environ.pop(env_name(name), None)
     else:
         os.environ[env_name(name)] = prev
+    _notify(name)
 
 
 def describe():

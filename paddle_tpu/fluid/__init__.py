@@ -13,6 +13,7 @@ from paddle_tpu import regularizer  # noqa: F401
 from paddle_tpu import clip  # noqa: F401
 from paddle_tpu import unique_name  # noqa: F401
 from paddle_tpu import metrics  # noqa: F401
+from paddle_tpu import observability  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 
 from paddle_tpu.framework import (  # noqa: F401

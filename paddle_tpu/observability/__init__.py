@@ -1,0 +1,142 @@
+"""paddle_tpu.observability — runtime telemetry across the engine seams.
+
+Two pieces (SURVEY §5 — the host half the device-side jax profiler does
+not cover):
+
+* a **metrics registry** (metrics.py): thread-safe counters / gauges /
+  timing histograms. The engine records cache hit/miss/eviction, compile
+  and per-step run wall time, feed/fetch byte counts and nan/inf-guard
+  trips; the transform pipeline records per-pass wall time and
+  rewrite-fire counts; the lowering records op counts.
+* a **span tracer** (tracing.py): RAII host spans (step → trace →
+  transform/verify/lower → compile/run), exportable as chrome-trace
+  JSON that merges with the xplane device traces tools/timeline.py
+  converts.
+
+Everything is gated by ``PADDLE_TPU_METRICS`` (flags.py): with the flag
+down every helper here is one module-bool check — no locks, no
+allocation — so the instrumented seams stay at PR-2 latency
+(tools/marginal_timing.py verifies the off path). The gate is cached in
+``_ENABLED`` and kept fresh by a flags change-hook, so
+``flags.set_flags({"metrics": True})`` takes effect immediately;
+``PADDLE_TPU_METRICS=1`` in the environment is read once at import.
+
+Entry points: ``snapshot()``, ``dump_chrome_trace(path)``,
+``inc/observe/set_gauge/time_block``, ``span/event``, ``reset()``.
+``paddle_tpu.profiler`` is the user-facing façade that starts/stops
+these host spans together with the jax device trace.
+"""
+
+from paddle_tpu import flags
+from paddle_tpu.observability.metrics import (  # noqa: F401
+    NULL_BLOCK,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    _TimeBlock,
+)
+from paddle_tpu.observability.tracing import (  # noqa: F401
+    SpanRecord,
+    SpanTracer,
+)
+
+__all__ = [
+    "MetricsRegistry", "SpanTracer", "counter_value", "dump_chrome_trace",
+    "enabled", "event", "inc", "observe", "registry", "reset",
+    "set_enabled", "set_gauge", "snapshot", "span", "spans", "time_block",
+    "tracer",
+]
+
+registry = MetricsRegistry()
+tracer = SpanTracer()
+
+_ENABLED = bool(flags.get_flag("metrics"))
+
+
+def set_enabled(value=None):
+    """Override the gate (``True``/``False``) or re-read the flag
+    (``None``). The profiler façade forces the gate up for the duration
+    of an explicit profiling session regardless of the flag."""
+    global _ENABLED
+    _ENABLED = (bool(flags.get_flag("metrics")) if value is None
+                else bool(value))
+
+
+flags.on_change("metrics", lambda _v: set_enabled(None))
+
+
+def enabled():
+    return _ENABLED
+
+
+# -- metrics ---------------------------------------------------------------
+def inc(name, n=1):
+    if _ENABLED:
+        registry.inc(name, n)
+
+
+def set_gauge(name, value):
+    if _ENABLED:
+        registry.set_gauge(name, value)
+
+
+def observe(name, value):
+    if _ENABLED:
+        registry.observe(name, value)
+
+
+def time_block(name):
+    """Ctx mgr recording the block's wall time (ms) into histogram
+    ``name`` — a metric only, no span."""
+    if not _ENABLED:
+        return NULL_BLOCK
+    return _TimeBlock(registry, name)
+
+
+def counter_value(name, default=0):
+    return registry.counter_value(name, default)
+
+
+# -- spans -----------------------------------------------------------------
+def span(name, **args):
+    """RAII host span: wall start + duration, nests per thread."""
+    if not _ENABLED:
+        return NULL_BLOCK
+    return tracer.span(name, **args)
+
+
+def event(name, **args):
+    """Zero-duration instant marker in the trace."""
+    if _ENABLED:
+        tracer.event(name, **args)
+
+
+def spans():
+    return tracer.spans()
+
+
+# -- export ----------------------------------------------------------------
+def snapshot():
+    """One plain dict of everything recorded: counters, gauges,
+    histogram summaries, and the per-span-name aggregate."""
+    out = registry.snapshot()
+    out["spans"] = tracer.summary()
+    dropped = tracer.dropped
+    if dropped:
+        out["dropped_spans"] = dropped
+    return out
+
+
+def dump_chrome_trace(path, xplane_dir=None):
+    """Write the host spans as chrome-trace JSON (load in
+    chrome://tracing or perfetto). With ``xplane_dir`` the device planes
+    are merged into the same file as additional processes."""
+    return tracer.dump_chrome_trace(path, xplane_dir=xplane_dir)
+
+
+def reset():
+    """Drop all recorded metrics AND spans (test isolation; the
+    conftest fixture calls this around every test)."""
+    registry.reset()
+    tracer.reset()

@@ -1,0 +1,188 @@
+"""Thread-safe metrics registry: counters, gauges, timing histograms.
+
+The host-side half of the reference's profiler bookkeeping (reference:
+paddle/fluid/platform/profiler.cc Event/EventList + the
+FLAGS_benchmark per-op counters in framework/operator.cc): every engine
+seam increments named counters and records wall-time observations here,
+and ``snapshot()`` returns one plain-dict view a bench, test, or
+perf_report can serialize.
+
+Gated by ``PADDLE_TPU_METRICS`` (flags.py). The off path is a handful of
+module-bool checks per step — no locks taken, no objects allocated — so
+instrumented seams cost nothing when the flag is down (measured against
+the marginal-timing protocol; see tests/test_observability.py).
+
+Usage::
+
+    from paddle_tpu import observability as obs
+    obs.inc("engine.cache_miss")
+    obs.observe("engine.compile_ms", wall_ms)
+    with obs.time_block("transform.cse"):   # histogram of the block wall
+        ...
+    obs.snapshot()   # {"counters": {...}, "gauges": {...},
+                     #  "histograms": {name: {count, total, mean, ...}}}
+"""
+
+import threading
+import time
+
+# Bounded per-histogram sample tail kept for percentiles; totals/extrema
+# are exact over every observation regardless.
+_HIST_TAIL = 512
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, v):
+        self.value = v
+
+
+class Histogram:
+    """Exact count/total/min/max over all observations plus a bounded
+    tail of recent samples for percentiles."""
+
+    __slots__ = ("count", "total", "min", "max", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.samples = []
+
+    def record(self, v):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self.samples.append(v)
+        if len(self.samples) > _HIST_TAIL:
+            del self.samples[: len(self.samples) - _HIST_TAIL]
+
+    def percentile(self, q):
+        if not self.samples:
+            return None
+        s = sorted(self.samples)
+        idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+        return s[idx]
+
+    def describe(self):
+        return {
+            "count": self.count,
+            "total": self.total,
+            "mean": self.total / self.count if self.count else None,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p99": self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    """One lock for the whole registry: the seams record a handful of
+    values per *step* (not per op), so contention is nil and a single
+    lock keeps snapshot/reset trivially consistent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    # -- record -----------------------------------------------------------
+    def inc(self, name, n=1):
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+            c.inc(n)
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge()
+            g.set(value)
+
+    def observe(self, name, value):
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram()
+            h.record(value)
+
+    # -- read -------------------------------------------------------------
+    def counter_value(self, name, default=0):
+        with self._lock:
+            c = self._counters.get(name)
+            return c.value if c is not None else default
+
+    def histogram(self, name):
+        with self._lock:
+            return self._histograms.get(name)
+
+    def snapshot(self):
+        """Plain-dict view of everything recorded so far (safe to
+        json.dumps). Values are copied out under the lock; the live
+        registry keeps recording."""
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {k: h.describe()
+                               for k, h in self._histograms.items()},
+            }
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+class _TimeBlock:
+    """Reusable-shape timing ctx mgr: records the block's wall clock in
+    MILLISECONDS into a histogram on exit."""
+
+    __slots__ = ("registry", "name", "_t0")
+
+    def __init__(self, registry, name):
+        self.registry = registry
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.registry.observe(
+            self.name, (time.perf_counter() - self._t0) * 1e3)
+        return False
+
+
+class _NullBlock:
+    """Shared no-op ctx mgr for the flag-off path."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+NULL_BLOCK = _NullBlock()

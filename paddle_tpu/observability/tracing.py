@@ -1,0 +1,190 @@
+"""Host-side span tracer: RAII wall-clock spans, nestable, exported as
+chrome-trace JSON.
+
+The host half of the reference's RecordEvent timeline (reference:
+platform/profiler.h:82 RecordEvent + tools/timeline.py chrome-trace
+export): ``span("compile")`` records start + duration on exit, spans
+nest per thread, and ``chrome_trace()`` emits the same event schema
+tools/timeline.py produces from the jax xplane dump — complete
+("ph": "X") slices with microsecond timestamps — so a host dump and a
+device trace load side by side in chrome://tracing / perfetto and line
+up on the wall clock (both timebases are ns-since-epoch).
+
+Span timestamps come from ``perf_counter_ns`` re-anchored to the epoch
+once at import: monotonic durations, epoch-aligned starts.
+"""
+
+import json
+import threading
+import time
+
+# perf_counter is monotonic but has an arbitrary zero; anchor it to the
+# epoch once so span starts align with device-trace timestamps.
+_EPOCH_ANCHOR_NS = time.time_ns() - time.perf_counter_ns()
+
+# Finished spans are capped so a long serving loop with tracing left on
+# degrades to "recent window + dropped count", never unbounded RAM.
+MAX_SPANS = 100000
+
+
+class SpanRecord:
+    __slots__ = ("name", "ts_us", "dur_us", "tid", "depth", "args")
+
+    def __init__(self, name, ts_us, dur_us, tid, depth, args):
+        self.name = name
+        self.ts_us = ts_us
+        self.dur_us = dur_us
+        self.tid = tid
+        self.depth = depth
+        self.args = args
+
+    def __repr__(self):
+        return "SpanRecord(%r, ts=%.1fus, dur=%.1fus, depth=%d)" % (
+            self.name, self.ts_us, self.dur_us, self.depth)
+
+
+class SpanTracer:
+    def __init__(self, max_spans=MAX_SPANS):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._spans = []
+        self._dropped = 0
+        self._max_spans = max_spans
+
+    # -- record -----------------------------------------------------------
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _add(self, rec):
+        with self._lock:
+            if len(self._spans) >= self._max_spans:
+                self._dropped += 1
+                return
+            self._spans.append(rec)
+
+    def span(self, name, **args):
+        return _Span(self, name, args)
+
+    def event(self, name, **args):
+        """Zero-duration instant marker (chrome-trace "i" events) — e.g.
+        a nan/inf-guard trip, a cache eviction."""
+        now_us = (_EPOCH_ANCHOR_NS + time.perf_counter_ns()) / 1e3
+        self._add(SpanRecord(name, now_us, 0.0, threading.get_ident(),
+                             len(self._stack()), args or None))
+
+    # -- read -------------------------------------------------------------
+    def spans(self):
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def dropped(self):
+        with self._lock:
+            return self._dropped
+
+    def reset(self):
+        with self._lock:
+            self._spans = []
+            self._dropped = 0
+
+    def chrome_trace_events(self, pid=1, process_name="paddle_tpu host"):
+        """Chrome-trace event dicts for every recorded span: per-process
+        and per-thread name metadata, "X" slices for spans, "i" instants
+        for zero-duration events."""
+        spans = self.spans()
+        events = [{"name": "process_name", "ph": "M", "pid": pid,
+                   "args": {"name": process_name}}]
+        tids = {}
+        for s in spans:
+            if s.tid not in tids:
+                tids[s.tid] = len(tids)
+                events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                               "tid": tids[s.tid],
+                               "args": {"name": "host thread %d"
+                                        % tids[s.tid]}})
+        for s in spans:
+            ev = {"name": s.name, "pid": pid, "tid": tids[s.tid],
+                  "ts": s.ts_us}
+            if s.dur_us > 0.0:
+                ev["ph"] = "X"
+                ev["dur"] = s.dur_us
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            if s.args:
+                ev["args"] = dict(s.args)
+            events.append(ev)
+        return events
+
+    def chrome_trace(self, xplane_dir=None):
+        """Full chrome-trace dict. With ``xplane_dir`` the device planes
+        converted by tools/timeline.py are merged in as further
+        processes — one file, host spans above the device lanes, shared
+        wall clock."""
+        events = self.chrome_trace_events()
+        if xplane_dir is not None:
+            from tools.timeline import xplane_to_chrome_trace
+
+            device = xplane_to_chrome_trace(xplane_dir)["traceEvents"]
+            for ev in device:
+                ev = dict(ev)
+                ev["pid"] = ev.get("pid", 1) + 1  # host trace owns pid 1
+                events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_chrome_trace(self, path, xplane_dir=None):
+        trace = self.chrome_trace(xplane_dir=xplane_dir)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+    def summary(self):
+        """Aggregate by span name: {name: {calls, total_ms, min_ms,
+        max_ms, ave_ms}} — the reference profiler's summary-table rows
+        (reference: platform/profiler.cc PrintProfiler)."""
+        agg = {}
+        for s in self.spans():
+            row = agg.setdefault(s.name, {"calls": 0, "total_ms": 0.0,
+                                          "min_ms": None, "max_ms": None})
+            ms = s.dur_us / 1e3
+            row["calls"] += 1
+            row["total_ms"] += ms
+            row["min_ms"] = ms if row["min_ms"] is None else min(
+                row["min_ms"], ms)
+            row["max_ms"] = ms if row["max_ms"] is None else max(
+                row["max_ms"], ms)
+        for row in agg.values():
+            row["ave_ms"] = row["total_ms"] / row["calls"]
+        return agg
+
+
+class _Span:
+    """RAII span: start on __enter__, record on __exit__ (also usable as
+    a decorator-free plain object for manual begin/end)."""
+
+    __slots__ = ("tracer", "name", "args", "_t0_ns", "_depth")
+
+    def __init__(self, tracer, name, args):
+        self.tracer = tracer
+        self.name = name
+        self.args = args or None
+
+    def __enter__(self):
+        stack = self.tracer._stack()
+        self._depth = len(stack)
+        stack.append(self)
+        self._t0_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_ns = time.perf_counter_ns() - self._t0_ns
+        stack = self.tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        self.tracer._add(SpanRecord(
+            self.name, (_EPOCH_ANCHOR_NS + self._t0_ns) / 1e3,
+            dur_ns / 1e3, threading.get_ident(), self._depth, self.args))
+        return False

@@ -1,30 +1,108 @@
-"""Profiler (reference: python/paddle/fluid/profiler.py — profiler ctx
-mgr:221, start/stop_profiler:125,165, cuda_profiler:39) — backed by the JAX
-profiler, whose traces load in TensorBoard/XProf (the XPlane equivalent of
-the reference's CUPTI + chrome-trace pipeline, SURVEY.md §5)."""
+"""Profiler façade (reference: python/paddle/fluid/profiler.py — profiler
+ctx mgr:221, start/stop_profiler:125,165, cuda_profiler:39).
+
+Drives BOTH halves of the telemetry stack together:
+
+* the **device** half: the JAX profiler, whose xplane traces load in
+  TensorBoard/XProf and convert to chrome-trace JSON via
+  tools/timeline.py (the CUPTI + chrome-trace pipeline of the
+  reference, SURVEY.md §5);
+* the **host** half: paddle_tpu.observability spans (step → trace →
+  transform/lower → compile/run) and the metrics registry.
+  ``start_profiler`` forces the host collectors on for the session even
+  when ``PADDLE_TPU_METRICS`` is down; ``stop_profiler`` restores the
+  flag-controlled gate.
+
+``stop_profiler(sorted_key, profile_path)`` writes the host-span summary
+table to ``profile_path`` sorted by ``sorted_key`` (calls / total / max /
+min / ave — the reference's EventSortingKey set) and also dumps the host
+spans as chrome-trace JSON next to it (``<profile_path>.trace.json``),
+ready to merge with the device timeline.
+"""
 
 import contextlib
 import os
 
 import jax
 
+from paddle_tpu import flags, observability
+
 _trace_dir = None
+_device_trace_on = False
+
+_SORT_KEYS = {
+    None: None,
+    "default": None,
+    "calls": "calls",
+    "total": "total_ms",
+    "max": "max_ms",
+    "min": "min_ms",
+    "ave": "ave_ms",
+}
 
 
 def start_profiler(state="All", tracer_option=None):
-    global _trace_dir
-    _trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+    """Start the device trace AND the host span/metric collectors
+    (``state``/``tracer_option`` kept for reference API parity)."""
+    global _trace_dir, _device_trace_on
+    observability.set_enabled(True)
+    _trace_dir = (flags.get_flag("trace_dir")
+                  or os.environ.get("PADDLE_TPU_TRACE_DIR")
+                  or "/tmp/paddle_tpu_trace")
     jax.profiler.start_trace(_trace_dir)
+    _device_trace_on = True
+
+
+def summary_table(sorted_key=None):
+    """The host-span summary as text (reference:
+    platform/profiler.cc PrintProfiler's table): one row per span name
+    with calls / total / min / max / ave milliseconds."""
+    if sorted_key not in _SORT_KEYS:
+        raise ValueError(
+            "sorted_key must be one of %s, got %r"
+            % (sorted(k for k in _SORT_KEYS if k), sorted_key))
+    agg = observability.tracer.summary()
+    rows = list(agg.items())
+    field = _SORT_KEYS[sorted_key]
+    if field is not None:
+        rows.sort(key=lambda kv: kv[1][field], reverse=field != "min_ms")
+    lines = ["%-32s %8s %12s %12s %12s %12s"
+             % ("Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                "Ave(ms)")]
+    for name, r in rows:
+        lines.append("%-32s %8d %12.3f %12.3f %12.3f %12.3f"
+                     % (name[:32], r["calls"], r["total_ms"], r["min_ms"],
+                        r["max_ms"], r["ave_ms"]))
+    if not rows:
+        lines.append("(no host spans recorded)")
+    return "\n".join(lines)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    jax.profiler.stop_trace()
+    """Stop both halves; write the host summary table to
+    ``profile_path`` honoring ``sorted_key`` (reference profiler.py:165
+    contract — the arguments are no longer ignored) and the host spans
+    as chrome-trace JSON to ``<profile_path>.trace.json``."""
+    global _device_trace_on
+    if _device_trace_on:
+        jax.profiler.stop_trace()
+        _device_trace_on = False
+    table = summary_table(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(table + "\n")
+        observability.dump_chrome_trace(profile_path + ".trace.json")
+    observability.set_enabled(None)  # back to the PADDLE_TPU_METRICS gate
     if _trace_dir:
-        print("profiler trace written to %s (open with TensorBoard)" % _trace_dir)
+        print("profiler: device trace in %s (TensorBoard/XProf; "
+              "tools/timeline.py converts to chrome-trace), host summary "
+              "in %s" % (_trace_dir, profile_path))
 
 
 def reset_profiler():
-    pass
+    """Drop all recorded host spans and metrics (reference
+    profiler.py:148 reset_profiler)."""
+    observability.reset()
 
 
 @contextlib.contextmanager
@@ -46,6 +124,8 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 @contextlib.contextmanager
 def record_event(name):
-    """RAII span (reference: platform/profiler.h:82 RecordEvent)."""
-    with jax.profiler.TraceAnnotation(name):
+    """RAII span (reference: platform/profiler.h:82 RecordEvent) — lands
+    in BOTH timelines: a host observability span and a device-trace
+    annotation the xplane dump attributes kernels to."""
+    with observability.span(name), jax.profiler.TraceAnnotation(name):
         yield

@@ -21,6 +21,23 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_observability():
+    """Global telemetry state (metrics registry + span tracer) never
+    leaks across tests: reset before AND after every test, and restore
+    the flag-derived gate in case a test forced it."""
+    from paddle_tpu import observability
+
+    observability.reset()
+    observability.set_enabled(None)
+    yield
+    observability.reset()
+    observability.set_enabled(None)
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--verify-programs", action="store_true", default=False,

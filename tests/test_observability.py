@@ -1,0 +1,334 @@
+"""paddle_tpu.observability — counter/gauge/histogram semantics, span
+nesting, chrome-trace JSON schema, the flag-gated no-op path, the engine
+seams (cache hit/miss counters, compile-wall histogram, nested
+step→trace→transform→lower + compile/run spans on a real BERT step),
+the upgraded nan/inf guard, and the profiler façade (stop_profiler
+writing the summary table it used to ignore)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags, models, observability as obs
+from paddle_tpu.observability.metrics import Histogram, MetricsRegistry
+from paddle_tpu.observability.tracing import SpanTracer
+
+
+@pytest.fixture
+def metrics_on():
+    flags.set_flags({"metrics": True})
+    try:
+        yield
+    finally:
+        flags.reset_flag("metrics")
+
+
+# -- registry semantics --------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    r = MetricsRegistry()
+    r.inc("c")
+    r.inc("c", 4)
+    r.set_gauge("g", 7.5)
+    for v in (1.0, 2.0, 3.0, 10.0):
+        r.observe("h", v)
+    snap = r.snapshot()
+    assert snap["counters"]["c"] == 5
+    assert snap["gauges"]["g"] == 7.5
+    h = snap["histograms"]["h"]
+    assert h["count"] == 4
+    assert h["total"] == 16.0
+    assert h["mean"] == 4.0
+    assert h["min"] == 1.0 and h["max"] == 10.0
+    assert h["p50"] in (2.0, 3.0)
+    r.reset()
+    assert r.snapshot() == {"counters": {}, "gauges": {},
+                            "histograms": {}}
+    json.dumps(snap)  # snapshot is plain-JSON serializable
+
+
+def test_histogram_bounded_tail_keeps_exact_totals():
+    h = Histogram()
+    for i in range(2000):
+        h.record(float(i))
+    assert h.count == 2000
+    assert h.total == sum(range(2000))
+    assert h.min == 0.0 and h.max == 1999.0
+    assert len(h.samples) <= 512  # the percentile tail is bounded
+
+
+def test_registry_thread_safety():
+    r = MetricsRegistry()
+
+    def work():
+        for _ in range(1000):
+            r.inc("n")
+            r.observe("h", 1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert r.counter_value("n") == 8000
+    assert r.histogram("h").count == 8000
+
+
+# -- span tracer ---------------------------------------------------------
+
+def test_span_nesting_and_containment():
+    tr = SpanTracer()
+    with tr.span("outer", tag="a"):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner2"):
+            pass
+    by_name = {s.name: s for s in tr.spans()}
+    outer, inner = by_name["outer"], by_name["inner"]
+    assert outer.depth == 0 and inner.depth == 1
+    assert by_name["inner2"].depth == 1
+    # children fall inside the parent's [start, start+dur) window
+    for child in (inner, by_name["inner2"]):
+        assert outer.ts_us <= child.ts_us
+        assert child.ts_us + child.dur_us <= outer.ts_us + outer.dur_us + 1
+    assert outer.args == {"tag": "a"}
+
+
+def test_span_cap_drops_not_grows():
+    tr = SpanTracer(max_spans=3)
+    for i in range(5):
+        with tr.span("s%d" % i):
+            pass
+    assert len(tr.spans()) == 3
+    assert tr.dropped == 2
+    tr.reset()
+    assert tr.spans() == [] and tr.dropped == 0
+
+
+def test_chrome_trace_schema():
+    tr = SpanTracer()
+    with tr.span("step", step=1):
+        with tr.span("compile"):
+            pass
+    tr.event("nan_inf_trip", var="x")
+    trace = tr.chrome_trace()
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    assert trace["displayTimeUnit"] == "ms"
+    evs = trace["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in metas}
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in slices} == {"step", "compile"}
+    for e in slices:
+        assert e["ts"] > 0 and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert instants and instants[0]["name"] == "nan_inf_trip"
+    json.loads(json.dumps(trace))  # round-trips as valid JSON
+
+
+def test_dump_chrome_trace_and_perf_report(tmp_path, metrics_on):
+    with obs.span("step", step=1):
+        with obs.span("trace"):
+            pass
+        with obs.span("run"):
+            pass
+    path = str(tmp_path / "host.json")
+    assert obs.dump_chrome_trace(path) == path
+    from tools.perf_report import per_step_rows, report
+
+    rows = per_step_rows(
+        [e for e in json.load(open(path))["traceEvents"]
+         if e.get("ph") == "X"])
+    assert len(rows) == 1
+    assert rows[0]["step"] == 1
+    assert rows[0]["total_ms"] >= rows[0]["trace"] + rows[0]["run"]
+    text = report(path)
+    assert "per-step wall" in text
+
+
+# -- flag gating ---------------------------------------------------------
+
+def test_disabled_is_noop():
+    assert not obs.enabled()
+    obs.inc("engine.cache_miss")
+    obs.observe("engine.compile_ms", 5.0)
+    obs.set_gauge("g", 1)
+    with obs.span("step"):
+        pass
+    obs.event("e")
+    snap = obs.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert snap["spans"] == {}
+    # the off-path span is the shared no-op ctx mgr — no allocation
+    assert obs.span("a") is obs.span("b")
+    assert obs.time_block("a") is obs.time_block("b")
+
+
+def test_flag_toggles_gate_immediately():
+    flags.set_flags({"metrics": True})
+    try:
+        assert obs.enabled()
+        obs.inc("c")
+        assert obs.counter_value("c") == 1
+    finally:
+        flags.reset_flag("metrics")
+    assert not obs.enabled()
+    obs.inc("c")
+    assert obs.counter_value("c") == 1  # unchanged after the gate drops
+
+
+# -- the engine seams ----------------------------------------------------
+
+def _bert_step_programs():
+    main, startup, h = models.bert.get_model(
+        batch_size=2, seq_len=16, vocab_size=100, d_model=32, n_layers=1,
+        n_heads=2, d_inner=64, dropout=0.0, lr=1e-3, max_position=64)
+    batch = models.bert.make_fake_batch(2, 16, 100, 2)
+    return main, startup, h, batch
+
+
+def test_engine_counters_and_spans_on_bert_step(metrics_on):
+    """The acceptance scenario: one BERT engine step records
+    cache_miss=1 on the first run, cache_hit=1 on the second, a nonzero
+    compile-wall histogram, and a span tree with
+    step→trace→(transform, lower) plus compile/run slices."""
+    main, startup, h, batch = _bert_step_programs()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        obs.reset()  # isolate the main-program steps from startup
+        exe.run(main, feed=batch, fetch_list=[h["loss"]])
+        snap1 = obs.snapshot()
+        assert snap1["counters"]["engine.cache_miss"] == 1
+        assert "engine.cache_hit" not in snap1["counters"]
+        exe.run(main, feed=batch, fetch_list=[h["loss"]])
+    snap = obs.snapshot()
+    c = snap["counters"]
+    assert c["engine.cache_miss"] == 1
+    assert c["engine.cache_hit"] == 1
+    assert c["engine.feed_bytes"] > 0
+    assert c["engine.fetch_bytes"] > 0
+    comp = snap["histograms"]["engine.compile_ms"]
+    assert comp["count"] == 1 and comp["total"] > 0
+    assert snap["histograms"]["engine.run_ms"]["count"] == 1
+    assert snap["histograms"]["engine.trace_ms"]["count"] == 1
+    assert snap["histograms"]["lower.ops"]["count"] == 1
+
+    spans = obs.spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    for name in ("executor.run", "step", "trace", "transform", "lower",
+                 "compile", "run"):
+        assert name in by_name, "missing span %r" % name
+
+    def inside(child, parent):
+        return (parent.ts_us <= child.ts_us and child.ts_us + child.dur_us
+                <= parent.ts_us + parent.dur_us + 1)
+
+    step1 = by_name["step"][0]
+    assert inside(by_name["trace"][0], step1)
+    assert inside(by_name["transform"][0], by_name["trace"][0])
+    assert inside(by_name["lower"][0], by_name["trace"][0])
+    assert inside(by_name["compile"][0], step1)   # first step compiles
+    assert inside(by_name["run"][0], by_name["step"][1])  # second runs
+    assert len(by_name["trace"]) == 1  # the cache hit built nothing
+
+
+def test_nan_inf_guard_names_var_shape_dtype_step(metrics_on):
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        hid = fluid.layers.fc(input=x, size=4)
+        loss = fluid.layers.mean(fluid.layers.log(hid))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.engine.check_nan_inf = True
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(RuntimeError) as err:
+            exe.run(main, feed={"x": -np.ones((8, 4), np.float32)},
+                    fetch_list=[loss])
+    msg = str(err.value)
+    assert "check_nan_inf" in msg
+    assert "shape" in msg and "dtype" in msg and "step" in msg
+    assert "NaN" in msg and "Inf" in msg
+    assert obs.counter_value("engine.nan_inf_trips") == 1
+    trips = [s for s in obs.spans() if s.name == "nan_inf_trip"]
+    assert len(trips) == 1
+    # engine step counter: startup ran as step 1, the poisoned step is 2
+    assert trips[0].args["step"] == 2
+    assert trips[0].args["dtype"] == "float32"
+
+
+def test_transform_pass_metrics(metrics_on):
+    main, _, h, batch = _bert_step_programs()
+    from paddle_tpu.analysis import optimize_program
+
+    # Unfused build so the rewrite actually fires
+    main, _, h = models.bert.get_model(
+        batch_size=2, seq_len=16, vocab_size=100, d_model=32, n_layers=1,
+        n_heads=2, d_inner=64, dropout=0.0, lr=1e-3, max_position=64,
+        use_fused_attention=False)
+    desc, report = optimize_program(
+        main, level=1, feed_names=sorted(batch),
+        fetch_names=[h["loss"].name])
+    fired = report.rewrites.get("fuse-attention", 0)
+    assert fired >= 1
+    assert obs.counter_value("transform.fuse-attention.rewrites") == fired
+    assert obs.counter_value("transform.rewrites") == report.total
+    hists = obs.snapshot()["histograms"]
+    assert hists["transform.fuse-attention.ms"]["count"] == 1
+    assert hists["transform.pipeline_ms"]["count"] == 1
+
+
+# -- profiler façade -----------------------------------------------------
+
+def test_stop_profiler_writes_sorted_summary(tmp_path, monkeypatch):
+    """The reference API contract (profiler.py:125,165): stop_profiler
+    honors sorted_key and writes the table to profile_path instead of
+    ignoring both."""
+    from paddle_tpu import profiler
+
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path / "trace"))
+    ppath = str(tmp_path / "profile.txt")
+    with profiler.profiler(sorted_key="total", profile_path=ppath):
+        assert obs.enabled()  # session forces the host collectors on
+        with profiler.record_event("outer-span"):
+            with profiler.record_event("inner-span"):
+                np.ones(4).sum()
+    text = open(ppath).read()
+    assert "Event" in text and "Total(ms)" in text
+    assert "outer-span" in text and "inner-span" in text
+    # sorted by total desc: outer (contains inner) comes first
+    assert text.index("outer-span") < text.index("inner-span")
+    trace = json.load(open(ppath + ".trace.json"))
+    assert {e["name"] for e in trace["traceEvents"]
+            if e.get("ph") == "X"} >= {"outer-span", "inner-span"}
+    assert not obs.enabled()  # gate restored to the flag
+
+
+def test_stop_profiler_rejects_bad_sort_key(tmp_path):
+    from paddle_tpu import profiler
+
+    with pytest.raises(ValueError, match="sorted_key"):
+        profiler.summary_table("bogus")
+
+
+def test_reset_profiler_clears_state(metrics_on):
+    from paddle_tpu import profiler
+
+    obs.inc("c")
+    with obs.span("s"):
+        pass
+    profiler.reset_profiler()
+    snap = obs.snapshot()
+    assert snap["counters"] == {} and snap["spans"] == {}

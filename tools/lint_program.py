@@ -213,6 +213,11 @@ def main(argv=None):
     parser.add_argument("--list-passes", action="store_true",
                         help="list every registered pass (name, kind, "
                              "default on/off) and exit")
+    parser.add_argument("--timing", action="store_true",
+                        help="collect per-pass wall time via the "
+                             "telemetry registry (paddle_tpu."
+                             "observability) and print the table after "
+                             "linting")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="show INFO findings too")
     args = parser.parse_args(argv)
@@ -220,6 +225,11 @@ def main(argv=None):
     if args.list_passes:
         _list_passes()
         return 0
+
+    if args.timing:
+        from paddle_tpu import observability
+
+        observability.set_enabled(True)
 
     reports = []
     if args.program:
@@ -233,11 +243,34 @@ def main(argv=None):
                     "unknown model %r; known: %s" % (name, sorted(builders)))
             reports.append(_lint_built_model(name, builders[name], args))
 
+    if args.timing:
+        _print_timing()
+
     n_err = sum(len(r.errors) for r in reports)
     n_warn = sum(len(r.warnings) for r in reports)
     print("\nlint: %d program(s), %d error(s), %d warning(s)"
           % (len(reports), n_err, n_warn))
     return 1 if n_err else 0
+
+
+def _print_timing():
+    """Per-pass wall-time table from the telemetry registry: every
+    ``analysis.<checker>.ms`` and ``transform.<pass>.ms`` histogram the
+    lint run filled."""
+    from paddle_tpu import observability
+
+    hists = observability.snapshot()["histograms"]
+    rows = [(name, h) for name, h in sorted(hists.items())
+            if name.startswith(("analysis.", "transform."))]
+    print("\n== per-pass timings ==")
+    if not rows:
+        print("(no pass timings recorded)")
+        return
+    print("%-36s %6s %10s %10s" % ("pass", "calls", "total ms", "mean ms"))
+    for name, h in rows:
+        print("%-36s %6d %10.2f %10.2f"
+              % (name[:-3] if name.endswith(".ms") else name,
+                 h["count"], h["total"], h["mean"] or 0.0))
 
 
 if __name__ == "__main__":

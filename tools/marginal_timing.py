@@ -28,10 +28,17 @@ def run_marginal_protocol(variants, args, reps):
     import jax
     import numpy as np
 
+    # Each window is tagged with a host span (no-ops unless
+    # PADDLE_TPU_METRICS / a profiler session is up), so a protocol run
+    # dumps straight to chrome-trace: per-variant lo/hi windows as
+    # labeled slices, outlier reps visible at a glance.
+    from paddle_tpu import observability as obs
+
     wall = {}
     for key, (fn_lo, _, fn_hi, _) in variants.items():
-        jax.device_get(fn_lo(*args))        # compile + warm
-        jax.device_get(fn_hi(*args))
+        with obs.span("marginal:compile", variant=key):
+            jax.device_get(fn_lo(*args))    # compile + warm
+            jax.device_get(fn_hi(*args))
         wall[key] = ([], [])
     # One untimed interleaved round before timing starts: the first
     # *interleaved* dispatch after the compile loop still eats stragglers
@@ -39,14 +46,18 @@ def run_marginal_protocol(variants, args, reps):
     # rep 0 of whichever variant runs first — observed as a 65.5ms
     # flash_attn_bwd_ms spread against a 3.4ms median.
     for key, (fn_lo, _, fn_hi, _) in variants.items():
-        jax.device_get(fn_lo(*args))
-        jax.device_get(fn_hi(*args))
-    for _ in range(reps):
+        with obs.span("marginal:warmup", variant=key):
+            jax.device_get(fn_lo(*args))
+            jax.device_get(fn_hi(*args))
+    for rep in range(reps):
         for key, (fn_lo, _, fn_hi, _) in variants.items():
             for which, fn in ((0, fn_lo), (1, fn_hi)):
-                t0 = time.perf_counter()
-                jax.device_get(fn(*args))
-                wall[key][which].append(time.perf_counter() - t0)
+                with obs.span("marginal:rep", variant=key, rep=rep,
+                              window="hi" if which else "lo"):
+                    t0 = time.perf_counter()
+                    jax.device_get(fn(*args))
+                    dt = time.perf_counter() - t0
+                wall[key][which].append(dt)
     out = {}
     for key, (_, n_lo, _, n_hi) in variants.items():
         lo, hi = wall[key]

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Merge a host-span dump with xplane device aggregates into one
+per-step perf report.
+
+The host side comes from ``observability.dump_chrome_trace(path)`` (or
+the ``<profile_path>.trace.json`` stop_profiler writes): every engine
+step is a "step" slice with its trace/transform/lower/compile/run
+children. The device side comes from the jax profiler's xplane dump,
+aggregated per op by tools/xplane_top_ops.py. Together they answer the
+question the throughput number alone cannot: where did each step's wall
+time go — host build (trace/transform/lower), XLA compile, dispatch, or
+device kernels.
+
+Usage:
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \\
+        python tools/perf_report.py HOST_TRACE.json [XPLANE_DIR] [--top N]
+
+With no XPLANE_DIR (or without the xplane protos installed) the report
+is host-only.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+# The per-step breakdown columns, in pipeline order. "other" is the
+# step-slice remainder not covered by any of them.
+PHASES = ("trace", "transform", "lower", "compile", "run")
+
+
+def load_host_events(path):
+    with open(path) as f:
+        trace = json.load(f)
+    return [e for e in trace.get("traceEvents", [])
+            if e.get("ph") == "X"]
+
+
+def per_step_rows(events):
+    """Group host slices into steps: each "step" slice owns every slice
+    nested inside its [ts, ts+dur) window on the same pid/tid."""
+    steps = sorted((e for e in events if e["name"] == "step"),
+                   key=lambda e: e["ts"])
+    rows = []
+    for i, st in enumerate(steps):
+        t0, t1 = st["ts"], st["ts"] + st.get("dur", 0.0)
+        row = {"step": st.get("args", {}).get("step", i + 1),
+               "total_ms": st.get("dur", 0.0) / 1e3}
+        for ph in PHASES:
+            row[ph] = 0.0
+        for e in events:
+            if e is st or e.get("pid") != st.get("pid") \
+                    or e.get("tid") != st.get("tid"):
+                continue
+            if e["name"] in PHASES and t0 <= e["ts"] < t1:
+                row[e["name"]] += e.get("dur", 0.0) / 1e3
+        row["other"] = max(0.0, row["total_ms"] - sum(
+            row[ph] for ph in PHASES))
+        rows.append(row)
+    return rows
+
+
+def render_host(rows):
+    lines = ["== host: per-step wall (ms) =="]
+    hdr = ("step", "total") + PHASES + ("other",)
+    lines.append("  ".join("%9s" % h for h in hdr))
+    for r in rows:
+        lines.append("  ".join(
+            ["%9s" % r["step"], "%9.2f" % r["total_ms"]]
+            + ["%9.2f" % r[ph] for ph in PHASES]
+            + ["%9.2f" % r["other"]]))
+    if not rows:
+        lines.append("(no step spans in the host dump — was "
+                     "PADDLE_TPU_METRICS up?)")
+    return "\n".join(lines)
+
+
+def render_device(xplane_dir, top_n):
+    from tools.xplane_top_ops import top_ops
+
+    rows, total = top_ops(xplane_dir, top_n=top_n)
+    lines = ["", "== device: XLA-op time (total %.2f ms) ==" % total]
+    for name, ms in rows:
+        pct = (ms / total * 100) if total else 0.0
+        lines.append("%10.3f ms  %5.1f%%  %s" % (ms, pct, name[:80]))
+    return "\n".join(lines)
+
+
+def report(host_path, xplane_dir=None, top_n=15):
+    events = load_host_events(host_path)
+    rows = per_step_rows(events)
+    out = [render_host(rows)]
+    if rows:
+        n = len(rows)
+        tot = sum(r["total_ms"] for r in rows)
+        comp = sum(r["compile"] + r["trace"] for r in rows)
+        out.append("steps: %d  host wall: %.2f ms  build+compile: %.2f ms "
+                   "(%.1f%%)" % (n, tot, comp, comp / tot * 100 if tot
+                                 else 0.0))
+    if xplane_dir:
+        try:
+            out.append(render_device(xplane_dir, top_n))
+        except Exception as e:  # xplane protos absent / empty dir
+            out.append("\n(device aggregates unavailable: %s)" % e)
+    return "\n".join(out)
+
+
+def main(argv=None):
+    os.environ.setdefault(
+        "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    p = argparse.ArgumentParser(
+        description="Merged host-span + device-op perf report")
+    p.add_argument("host_trace", help="chrome-trace JSON from "
+                   "observability.dump_chrome_trace / stop_profiler")
+    p.add_argument("xplane_dir", nargs="?", default=None,
+                   help="jax profiler trace dir with .xplane.pb dumps")
+    p.add_argument("--top", type=int, default=15,
+                   help="device ops to list (default 15)")
+    args = p.parse_args(argv)
+    print(report(args.host_trace, args.xplane_dir, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
