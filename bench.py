@@ -522,13 +522,22 @@ def main():
         "vs_baseline": None,  # reference publishes no absolute throughput
     }
     errors = {}
+    peak_hbm = {}
 
     def _try(name, fn):
+        # Per-model HBM attribution: the memory watermarks reset before
+        # each bench, so the peak after it is THIS model's footprint
+        # (live-census + compile-time estimate; observability/memory.py).
+        observability.memory.reset_peaks()
         try:
-            return round(float(fn()), 2)
+            v = round(float(fn()), 2)
         except Exception as e:  # noqa: BLE001
             errors[name] = str(e)[:200]
             return None
+        peak = observability.memory.peak_hbm_bytes()
+        if peak:
+            peak_hbm[name] = int(peak)
+        return v
 
     if which in ("default", "all", "resnet50"):
         v = _try("resnet50", bench_resnet50)
@@ -607,6 +616,9 @@ def main():
             and k != "transform.rewrites"},
         "transform_rewrites_total": c.get("transform.rewrites", 0),
         "nan_inf_trips": c.get("engine.nan_inf_trips", 0),
+        # per-model device-memory high-watermark (bytes): BENCH_*.json
+        # tracks memory alongside throughput across rounds
+        "peak_hbm_bytes": peak_hbm,
     }
     if errors:
         result["errors"] = errors

@@ -31,6 +31,15 @@ def launch_processes(script_args, nproc=1, started_port=6170,
         env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
         # rank 0 hosts the PJRT coordinator (the gen_nccl_id analog)
         env["COORDINATOR_ADDRESS"] = endpoints[0]
+        # Per-worker telemetry stream: every worker writes its own
+        # host-tagged JSONL sink (<base>.h<rank>.jsonl) so a directory
+        # of dumps merges into one cross-host report
+        # (tools/perf_report.py --merge).
+        sink = env.get("PADDLE_TPU_METRICS_SINK")
+        if sink:
+            from paddle_tpu.observability.export import host_tagged_path
+
+            env["PADDLE_TPU_METRICS_SINK"] = host_tagged_path(sink, rank)
         cmd = [sys.executable] + list(script_args)
         procs.append(subprocess.Popen(cmd, env=env, stdout=pipe,
                                       stderr=pipe))

@@ -183,6 +183,22 @@ class Engine:
                                                  readonly, rng_seed)
         compiled.run_count += 1
 
+        if obs.enabled():
+            if first:
+                # Once per executable: the compile-time peak estimate
+                # (argument/output/temp bytes from XLA's own
+                # memory_analysis) — reuses jax's lowering caches for
+                # the executable that just ran, so this is a retrace,
+                # not a second XLA compile.
+                obs.memory.record_compile_memory(
+                    compiled.jitted,
+                    (feed_values, mutated, readonly, rng_seed),
+                    label="block%d" % block_idx)
+            # Every step: live-buffer census (scope-resident params vs
+            # transient feed/fetch/activation bytes), allocator stats,
+            # watermark, and the edge-triggered memory_pressure event.
+            obs.memory.record_step_memory(scope, step=self._run_counter)
+
         if self.check_nan_inf:
             _check_finite(
                 zip(compiled.block_program.state_out_names, state_out),

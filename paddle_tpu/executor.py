@@ -128,6 +128,15 @@ class Executor:
             out["memory"] = comp.memory_analysis()
         except Exception:  # pragma: no cover - backend-dependent
             pass
+        if out["memory"] is not None:
+            # The analysis feeds the same HBM gauges the engine seams
+            # record, so a roofline pass and a training run publish one
+            # consistent hbm.compile_* series.
+            from paddle_tpu import observability as obs
+
+            if obs.enabled():
+                obs.memory.record_compile_stats(out["memory"],
+                                                label="cost_analysis")
         return out
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",

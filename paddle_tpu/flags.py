@@ -74,6 +74,43 @@ DEFS = {
         "cache/compile/run counters + timing histograms and host-side "
         "spans exportable as chrome-trace JSON. Off = no-op stubs at "
         "every instrumented seam (near-zero overhead)."),
+    "metrics_sink": (
+        str, "",
+        "Streaming telemetry export (observability/export.py): path of a "
+        "JSONL sink file finished spans, instant events, and periodic "
+        "metric snapshots stream to as one-line JSON events. With a sink "
+        "attached the tracer's in-memory span list stays bounded (the "
+        "flight recorder holds the recent window) and dropped() stays 0 "
+        "on an unbounded loop. Multi-process runs tag the file per host "
+        "(<base>.h<rank>.jsonl). Empty = no sink."),
+    "metrics_sink_rotate_mb": (
+        float, 64.0,
+        "Size-based rotation threshold for the JSONL sink, in MiB: when "
+        "the live file crosses it, it is atomically renamed to "
+        "<path>.<seq> and a fresh file is opened. <=0 disables "
+        "rotation."),
+    "metrics_sink_keep": (
+        int, 8,
+        "Rotated JSONL files kept per sink (oldest pruned); the live "
+        "file is always kept. <=0 keeps every rotation."),
+    "flight_recorder_depth": (
+        int, 2048,
+        "Depth of the always-on in-memory flight recorder ring buffer: "
+        "the last N finished spans/events survive in RAM even after the "
+        "tracer would have dropped them or a sink streamed them out — "
+        "the post-mortem window a crashed run is diagnosed from."),
+    "memory_pressure_frac": (
+        float, 0.9,
+        "Fraction of device memory at which a step's live bytes raise a "
+        "memory_pressure telemetry event (observability/memory.py). "
+        "Device capacity comes from device.memory_stats() where the "
+        "backend reports it, else from device_memory_bytes."),
+    "device_memory_bytes": (
+        int, 0,
+        "Device memory capacity override in bytes for the "
+        "memory-pressure check, for backends whose memory_stats() "
+        "reports no bytes_limit (e.g. the CPU emulation mesh). "
+        "0 = trust the backend / disable the check when unreported."),
 }
 
 _overrides = {}

@@ -28,6 +28,11 @@ these host spans together with the jax device trace.
 """
 
 from paddle_tpu import flags
+from paddle_tpu.observability import export, memory  # noqa: F401
+from paddle_tpu.observability.export import (  # noqa: F401
+    FlightRecorder,
+    JsonlSink,
+)
 from paddle_tpu.observability.metrics import (  # noqa: F401
     NULL_BLOCK,
     Counter,
@@ -35,6 +40,7 @@ from paddle_tpu.observability.metrics import (  # noqa: F401
     Histogram,
     MetricsRegistry,
     _TimeBlock,
+    snapshot_text,
 )
 from paddle_tpu.observability.tracing import (  # noqa: F401
     SpanRecord,
@@ -42,14 +48,15 @@ from paddle_tpu.observability.tracing import (  # noqa: F401
 )
 
 __all__ = [
-    "MetricsRegistry", "SpanTracer", "counter_value", "dump_chrome_trace",
-    "enabled", "event", "inc", "observe", "registry", "reset",
-    "set_enabled", "set_gauge", "snapshot", "span", "spans", "time_block",
-    "tracer",
+    "FlightRecorder", "JsonlSink", "MetricsRegistry", "SpanTracer",
+    "attach_sink", "counter_value", "detach_sink", "dump_chrome_trace",
+    "enabled", "event", "flush_sink", "inc", "observe", "registry",
+    "reset", "set_enabled", "set_gauge", "sink", "snapshot",
+    "snapshot_text", "span", "spans", "time_block", "tracer",
 ]
 
 registry = MetricsRegistry()
-tracer = SpanTracer()
+tracer = SpanTracer(flight_depth=int(flags.get_flag("flight_recorder_depth")))
 
 _ENABLED = bool(flags.get_flag("metrics"))
 
@@ -68,6 +75,86 @@ flags.on_change("metrics", lambda _v: set_enabled(None))
 
 def enabled():
     return _ENABLED
+
+
+# -- streaming sink --------------------------------------------------------
+def sink():
+    """The active streaming sink, or None."""
+    return tracer.sink
+
+
+def attach_sink(path=None, host=None, **kwargs):
+    """Attach a rotating JSONL sink (export.JsonlSink) to the tracer:
+    finished spans/events stream to disk, tracer memory stays bounded at
+    the flight-recorder depth, ``dropped()`` stays 0 on unbounded loops.
+
+    ``path`` defaults to the ``PADDLE_TPU_METRICS_SINK`` flag; returns
+    None (and detaches nothing) when neither is set. Multi-process runs
+    (``host`` passed, or a launcher rank in the environment) write to
+    the host-tagged ``<base>.h<rank><ext>`` so per-worker dumps merge
+    cleanly (tools/perf_report.py --merge). Any previous sink is closed.
+    """
+    import os
+
+    path = path or flags.get_flag("metrics_sink")
+    if not path:
+        return None
+    explicit = host is not None
+    host = export.host_tag() if host is None else int(host)
+    try:
+        world = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE") or 1))
+    except ValueError:
+        world = 1
+    if explicit or host or world > 1:
+        path = export.host_tagged_path(path, host)
+    kwargs.setdefault(
+        "rotate_bytes",
+        int(float(flags.get_flag("metrics_sink_rotate_mb")) * 2 ** 20))
+    kwargs.setdefault("keep", int(flags.get_flag("metrics_sink_keep")))
+    kwargs.setdefault("snapshot_fn", registry.snapshot)
+    new = JsonlSink(path, host=host, **kwargs)
+    prev = tracer.attach_sink(new)
+    if prev is not None:
+        try:
+            prev.close()
+        except Exception:
+            pass
+    return new
+
+
+def detach_sink():
+    """Detach and close the active sink (final metric snapshot + flush
+    included). Returns the closed sink, or None."""
+    prev = tracer.detach_sink()
+    if prev is not None:
+        try:
+            prev.close()
+        except Exception:
+            pass
+    return prev
+
+
+def flush_sink():
+    s = tracer.sink
+    if s is not None:
+        s.flush()
+
+
+def _sink_flag_changed(value):
+    if value:
+        attach_sink(value)
+    else:
+        detach_sink()
+
+
+flags.on_change("metrics_sink", _sink_flag_changed)
+flags.on_change("flight_recorder_depth",
+                lambda v: tracer.set_flight_depth(int(v)))
+
+if flags.get_flag("metrics_sink"):
+    # PADDLE_TPU_METRICS_SINK in the environment: stream from import on.
+    attach_sink()
 
 
 # -- metrics ---------------------------------------------------------------
@@ -122,7 +209,7 @@ def snapshot():
     histogram summaries, and the per-span-name aggregate."""
     out = registry.snapshot()
     out["spans"] = tracer.summary()
-    dropped = tracer.dropped
+    dropped = tracer.dropped()
     if dropped:
         out["dropped_spans"] = dropped
     return out
@@ -137,6 +224,9 @@ def dump_chrome_trace(path, xplane_dir=None):
 
 def reset():
     """Drop all recorded metrics AND spans (test isolation; the
-    conftest fixture calls this around every test)."""
+    conftest fixture calls this around every test). Memory watermarks
+    reset too; an attached sink stays attached (stream files are
+    append-only history, not registry state)."""
     registry.reset()
     tracer.reset()
+    memory.reset_peaks()

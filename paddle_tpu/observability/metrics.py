@@ -75,17 +75,25 @@ class Histogram:
             del self.samples[: len(self.samples) - _HIST_TAIL]
 
     def percentile(self, q):
-        if not self.samples:
+        """Nearest-rank percentile over the bounded sample tail; a
+        zero-count histogram (or out-of-range ``q``) returns ``None``
+        instead of raising — a scrape must never crash on a metric that
+        has not fired yet."""
+        if self.count == 0 or not self.samples:
             return None
+        q = min(100.0, max(0.0, float(q)))
         s = sorted(self.samples)
         idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
         return s[idx]
 
     def describe(self):
+        if self.count == 0:
+            return {"count": 0, "total": 0.0, "mean": None, "min": None,
+                    "max": None, "p50": None, "p99": None}
         return {
             "count": self.count,
             "total": self.total,
-            "mean": self.total / self.count if self.count else None,
+            "mean": self.total / self.count,
             "min": self.min,
             "max": self.max,
             "p50": self.percentile(50),
@@ -153,6 +161,59 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+
+    def snapshot_text(self, prefix="paddle_tpu"):
+        """Prometheus-style text exposition of the registry (used by
+        tools/tpu_top.py's metrics panel and dumped by
+        profiler.stop_profiler as ``<profile_path>.metrics.prom``)."""
+        return snapshot_text(self.snapshot(), prefix=prefix)
+
+
+def _prom_name(prefix, name):
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    name = "".join(out)
+    return prefix + "_" + name if prefix else name
+
+
+def _prom_value(v):
+    if v is None:
+        return "NaN"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, int):
+        return str(v)
+    return "NaN"  # non-numeric gauge values are unrepresentable
+
+
+def snapshot_text(snap, prefix="paddle_tpu"):
+    """Render one ``MetricsRegistry.snapshot()``-shaped dict as
+    Prometheus text exposition format: counters as ``counter``, gauges
+    as ``gauge``, histograms as ``summary`` (quantile series + _sum +
+    _count). Standalone so offline consumers (tpu_top over a JSONL
+    "snap" event) render the identical text."""
+    lines = []
+    for name, v in sorted(snap.get("counters", {}).items()):
+        m = _prom_name(prefix, name)
+        lines.append("# TYPE %s counter" % m)
+        lines.append("%s %s" % (m, _prom_value(v)))
+    for name, v in sorted(snap.get("gauges", {}).items()):
+        m = _prom_name(prefix, name)
+        lines.append("# TYPE %s gauge" % m)
+        lines.append("%s %s" % (m, _prom_value(v)))
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        m = _prom_name(prefix, name)
+        lines.append("# TYPE %s summary" % m)
+        for q_key, q in (("p50", "0.5"), ("p99", "0.99")):
+            if h.get(q_key) is not None:
+                lines.append('%s{quantile="%s"} %s'
+                             % (m, q, _prom_value(h[q_key])))
+        lines.append("%s_sum %s" % (m, _prom_value(h.get("total", 0.0))))
+        lines.append("%s_count %s" % (m, _prom_value(h.get("count", 0))))
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 class _TimeBlock:

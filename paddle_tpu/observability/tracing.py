@@ -18,12 +18,17 @@ import json
 import threading
 import time
 
+from paddle_tpu.observability.export import (DEFAULT_FLIGHT_DEPTH,
+                                             FlightRecorder)
+
 # perf_counter is monotonic but has an arbitrary zero; anchor it to the
 # epoch once so span starts align with device-trace timestamps.
 _EPOCH_ANCHOR_NS = time.time_ns() - time.perf_counter_ns()
 
 # Finished spans are capped so a long serving loop with tracing left on
 # degrades to "recent window + dropped count", never unbounded RAM.
+# With a streaming sink attached (observability/export.py) the cap never
+# bites: spans stream to disk and only the flight recorder stays in RAM.
 MAX_SPANS = 100000
 
 
@@ -44,12 +49,14 @@ class SpanRecord:
 
 
 class SpanTracer:
-    def __init__(self, max_spans=MAX_SPANS):
+    def __init__(self, max_spans=MAX_SPANS, flight_depth=None):
         self._lock = threading.Lock()
         self._local = threading.local()
         self._spans = []
         self._dropped = 0
         self._max_spans = max_spans
+        self._sink = None
+        self._flight = FlightRecorder(flight_depth or DEFAULT_FLIGHT_DEPTH)
 
     # -- record -----------------------------------------------------------
     def _stack(self):
@@ -60,10 +67,51 @@ class SpanTracer:
 
     def _add(self, rec):
         with self._lock:
+            self._flight.add(rec)
+            sink = self._sink
+            if sink is not None:
+                # Streaming mode: the span goes to the sink, RAM keeps
+                # only the flight-recorder window — an unbounded loop
+                # never drops and never grows.
+                try:
+                    sink.emit_span(rec)
+                except Exception:
+                    self._dropped += 1
+                return
             if len(self._spans) >= self._max_spans:
                 self._dropped += 1
                 return
             self._spans.append(rec)
+
+    # -- sink / flight recorder -------------------------------------------
+    def attach_sink(self, sink):
+        """Route finished spans to ``sink`` (export.JsonlSink protocol:
+        ``emit_span(rec)``). Returns the previously attached sink (not
+        closed — the caller owns lifecycle)."""
+        with self._lock:
+            prev, self._sink = self._sink, sink
+            return prev
+
+    def detach_sink(self):
+        with self._lock:
+            prev, self._sink = self._sink, None
+            return prev
+
+    @property
+    def sink(self):
+        return self._sink
+
+    def flight(self):
+        """The flight recorder's current window (most recent last)."""
+        return self._flight.records()
+
+    def set_flight_depth(self, depth):
+        with self._lock:
+            self._flight.resize(depth)
+
+    @property
+    def flight_depth(self):
+        return self._flight.depth
 
     def span(self, name, **args):
         return _Span(self, name, args)
@@ -77,10 +125,13 @@ class SpanTracer:
 
     # -- read -------------------------------------------------------------
     def spans(self):
+        """Recorded spans: the in-memory list, or — in streaming mode,
+        where spans live on disk — the flight recorder's window."""
         with self._lock:
+            if self._sink is not None:
+                return self._flight.records()
             return list(self._spans)
 
-    @property
     def dropped(self):
         with self._lock:
             return self._dropped
@@ -89,6 +140,7 @@ class SpanTracer:
         with self._lock:
             self._spans = []
             self._dropped = 0
+            self._flight.clear()
 
     def chrome_trace_events(self, pid=1, process_name="paddle_tpu host"):
         """Chrome-trace event dicts for every recorded span: per-process

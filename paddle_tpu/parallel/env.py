@@ -42,4 +42,23 @@ def init_distributed(coordinator_address=None, num_processes=None,
         num_processes=num_processes,
         process_id=process_id,
     )
+    _retag_telemetry_sink(process_id)
     return {**info, "world_size": num_processes, "rank": process_id}
+
+
+def _retag_telemetry_sink(rank):
+    """Re-attach this worker's streaming telemetry sink under its
+    host-tagged path once the true rank is known: a worker launched
+    outside distributed/launch.py (no PADDLE_TRAINER_ID in the
+    environment) would otherwise stream to the shared untagged path and
+    per-worker dumps could not be told apart by perf_report --merge.
+    No-op when no sink is configured; idempotent when the launcher
+    already tagged the path."""
+    from paddle_tpu import flags, observability
+
+    if not flags.get_flag("metrics_sink"):
+        return
+    try:
+        observability.attach_sink(host=rank)
+    except Exception:
+        pass

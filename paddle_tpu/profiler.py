@@ -81,8 +81,13 @@ def summary_table(sorted_key=None):
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     """Stop both halves; write the host summary table to
     ``profile_path`` honoring ``sorted_key`` (reference profiler.py:165
-    contract — the arguments are no longer ignored) and the host spans
-    as chrome-trace JSON to ``<profile_path>.trace.json``."""
+    contract — the arguments are no longer ignored), the host spans as
+    chrome-trace JSON to ``<profile_path>.trace.json``, and the metrics
+    registry as Prometheus text exposition to
+    ``<profile_path>.metrics.prom`` (the ``snapshot_text`` dump a
+    scrape-less run still wants on disk). An attached streaming sink is
+    flushed so its JSONL tail is complete at the moment the session
+    ends."""
     global _device_trace_on
     if _device_trace_on:
         jax.profiler.stop_trace()
@@ -92,6 +97,9 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         with open(profile_path, "w") as f:
             f.write(table + "\n")
         observability.dump_chrome_trace(profile_path + ".trace.json")
+        with open(profile_path + ".metrics.prom", "w") as f:
+            f.write(observability.registry.snapshot_text())
+    observability.flush_sink()
     observability.set_enabled(None)  # back to the PADDLE_TPU_METRICS gate
     if _trace_dir:
         print("profiler: device trace in %s (TensorBoard/XProf; "

@@ -2,10 +2,15 @@
 nesting, chrome-trace JSON schema, the flag-gated no-op path, the engine
 seams (cache hit/miss counters, compile-wall histogram, nested
 step→trace→transform→lower + compile/run spans on a real BERT step),
-the upgraded nan/inf guard, and the profiler façade (stop_profiler
-writing the summary table it used to ignore)."""
+the upgraded nan/inf guard, the profiler façade (stop_profiler writing
+the summary table it used to ignore), and the streaming-export layer:
+JSONL sink rotation, the flight recorder, the unbounded-loop
+never-drops contract, device-memory accounting at the engine seams, the
+multi-worker merge (tools/perf_report.py --merge), and the tpu_top
+tail/render path."""
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -103,9 +108,9 @@ def test_span_cap_drops_not_grows():
         with tr.span("s%d" % i):
             pass
     assert len(tr.spans()) == 3
-    assert tr.dropped == 2
+    assert tr.dropped() == 2
     tr.reset()
-    assert tr.spans() == [] and tr.dropped == 0
+    assert tr.spans() == [] and tr.dropped() == 0
 
 
 def test_chrome_trace_schema():
@@ -332,3 +337,299 @@ def test_reset_profiler_clears_state(metrics_on):
     profiler.reset_profiler()
     snap = obs.snapshot()
     assert snap["counters"] == {} and snap["spans"] == {}
+
+
+def test_stop_profiler_writes_prom_metrics(tmp_path, monkeypatch):
+    """stop_profiler dumps the registry as Prometheus exposition next to
+    the summary table (``<profile_path>.metrics.prom``)."""
+    from paddle_tpu import profiler
+
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path / "trace"))
+    ppath = str(tmp_path / "profile.txt")
+    with profiler.profiler(profile_path=ppath):
+        obs.inc("engine.cache_hit", 2)
+        with profiler.record_event("work"):
+            np.ones(4).sum()
+    text = open(ppath + ".metrics.prom").read()
+    assert "# TYPE paddle_tpu_engine_cache_hit counter" in text
+    assert "paddle_tpu_engine_cache_hit 2" in text
+
+
+# -- histogram edge cases ------------------------------------------------
+
+def test_histogram_zero_count_percentile_and_describe():
+    h = Histogram()
+    assert h.percentile(50) is None
+    assert h.percentile(99) is None
+    d = h.describe()
+    assert d["count"] == 0 and d["total"] == 0.0
+    for key in ("mean", "min", "max", "p50", "p99"):
+        assert d[key] is None
+    h.record(7.0)
+    assert h.percentile(50) == 7.0
+    assert h.describe()["count"] == 1
+
+
+def test_snapshot_text_prometheus_exposition():
+    r = MetricsRegistry()
+    r.inc("engine.cache_hit", 3)
+    r.set_gauge("hbm.live_bytes", 123.0)
+    for v in (1.0, 2.0, 3.0):
+        r.observe("engine.run_ms", v)
+    text = r.snapshot_text()
+    assert "# TYPE paddle_tpu_engine_cache_hit counter" in text
+    assert "paddle_tpu_engine_cache_hit 3" in text
+    assert "# TYPE paddle_tpu_hbm_live_bytes gauge" in text
+    assert "paddle_tpu_hbm_live_bytes 123.0" in text
+    assert "# TYPE paddle_tpu_engine_run_ms summary" in text
+    assert 'paddle_tpu_engine_run_ms{quantile="0.5"} 2.0' in text
+    assert "paddle_tpu_engine_run_ms_sum 6.0" in text
+    assert "paddle_tpu_engine_run_ms_count 3" in text
+    # an empty histogram still renders (NaN quantiles, count 0)
+    r2 = MetricsRegistry()
+    r2.observe("h", 1.0)
+    r2.histogram("h").samples.clear()
+    assert "paddle_tpu_h_count" in r2.snapshot_text()
+
+
+# -- streaming export ----------------------------------------------------
+
+def test_flight_recorder_ring_bounds():
+    from paddle_tpu.observability.export import FlightRecorder
+
+    fr = FlightRecorder(depth=4)
+    for i in range(10):
+        fr.add(i)
+    assert fr.records() == [6, 7, 8, 9]
+    assert len(fr) == 4 and fr.depth == 4
+    fr.resize(2)
+    assert fr.records() == [8, 9]
+    fr.clear()
+    assert fr.records() == []
+
+
+def test_host_tagged_path_idempotent():
+    from paddle_tpu.observability.export import host_tagged_path
+
+    p = host_tagged_path("/x/metrics.jsonl", 3)
+    assert p == "/x/metrics.h3.jsonl"
+    assert host_tagged_path(p, 3) == p  # re-tagging is a no-op
+
+
+def test_streaming_sink_unbounded_loop_never_drops(tmp_path):
+    """The acceptance scenario: a span loop far past the tracer cap with
+    a JSONL sink attached ends with ``dropped() == 0``, tracer memory
+    bounded at the flight-recorder depth, and a parseable rotated file
+    set whose newest events are intact and ordered."""
+    from paddle_tpu.observability.export import (JsonlSink, iter_events,
+                                                 sink_file_set)
+
+    path = str(tmp_path / "metrics.jsonl")
+    tr = SpanTracer(max_spans=100, flight_depth=64)
+    sink = JsonlSink(path, rotate_bytes=256 * 1024, keep=4, host=0)
+    tr.attach_sink(sink)
+    n = 250000
+    for i in range(n):
+        with tr.span("step", step=i):
+            pass
+    assert tr.dropped() == 0            # the cap never bit
+    assert len(tr._spans) == 0          # nothing accumulated in RAM
+    assert len(tr.spans()) <= tr.flight_depth
+    sink.close()
+    files = sink_file_set(path)
+    assert files[-1] == path
+    assert 2 <= len(files) <= 5         # rotated, pruned to keep=4 + live
+    events = [ev for p in files for ev in iter_events(p)]
+    steps = [ev["args"]["step"] for ev in events
+             if ev.get("t") == "span" and ev.get("name") == "step"]
+    assert steps and steps[-1] == n - 1
+    assert steps == sorted(steps)
+    assert all(ev.get("host") == 0 for ev in events)
+    tr.detach_sink()
+
+
+def test_sink_rotation_file_set_and_reattach(tmp_path):
+    from paddle_tpu.observability.export import JsonlSink, sink_file_set
+
+    path = str(tmp_path / "m.jsonl")
+    s = JsonlSink(path, rotate_bytes=2048, keep=3, host=0)
+    for i in range(400):
+        s.emit({"t": "span", "name": "s", "ts": float(i), "dur": 1.0})
+    s.close()
+    files = sink_file_set(path)
+    assert files[-1] == path
+    rotated = files[:-1]
+    assert 1 <= len(rotated) <= 3       # pruned down to keep
+    seqs = [int(p.rsplit(".", 1)[1]) for p in rotated]
+    assert seqs == sorted(seqs)
+    # reattaching to the same path never clobbers an existing rotation
+    s2 = JsonlSink(path, rotate_bytes=2048, keep=3, host=0)
+    for i in range(400):
+        s2.emit({"t": "span", "name": "s", "ts": float(i), "dur": 1.0})
+    s2.close()
+    new_seqs = [int(p.rsplit(".", 1)[1])
+                for p in sink_file_set(path)[:-1]]
+    assert max(new_seqs) > max(seqs)
+
+
+def test_attach_sink_via_flag_and_flight_depth(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    flags.set_flags({"metrics_sink": path})
+    try:
+        s = obs.sink()
+        assert s is not None and obs.tracer.sink is s
+        assert os.path.exists(s.path)
+    finally:
+        flags.reset_flag("metrics_sink")
+    assert obs.sink() is None           # flag cleared -> sink detached
+    flags.set_flags({"flight_recorder_depth": 16})
+    try:
+        assert obs.tracer.flight_depth == 16
+    finally:
+        flags.reset_flag("flight_recorder_depth")
+
+
+# -- multi-host merge ----------------------------------------------------
+
+def test_perf_report_merge_round_trips_host_ids(tmp_path):
+    """Two host-tagged worker dumps merge into the cross-host report:
+    step skew, slowest-worker attribution, per-host HBM watermarks."""
+    from paddle_tpu.observability.export import JsonlSink
+    from tools.perf_report import load_worker_dumps, merge_report
+
+    d = str(tmp_path)
+    for host, base_ms in ((0, 10.0), (1, 14.0)):
+        path = os.path.join(d, "metrics.h%d.jsonl" % host)
+        s = JsonlSink(path, rotate_bytes=0, keep=0, host=host)
+        for step in range(1, 6):
+            s.emit({"t": "span", "name": "step",
+                    "ts": step * 1e6, "dur": (base_ms + step) * 1e3,
+                    "tid": 1, "depth": 0, "args": {"step": step}})
+        s.emit({"t": "snap", "ts": 6e6, "metrics": {
+            "gauges": {"hbm.live_bytes_peak": (host + 1) * 1000,
+                       "hbm.compile_peak_bytes": (host + 1) * 2000}}})
+        s.close()
+    workers = load_worker_dumps(d)
+    assert sorted(workers) == [0, 1]    # host ids round-trip
+    assert workers[0]["steps"][3] == pytest.approx(13.0)
+    assert workers[1]["steps"][3] == pytest.approx(17.0)
+    assert workers[1]["hbm"]["hbm.live_bytes_peak"] == 2000
+    text = merge_report(d)
+    assert "h0" in text and "h1" in text
+    assert "skew" in text and "slowest" in text
+    assert "slowest-worker attribution: h1 5/5" in text
+    assert "fleet max" in text
+
+
+# -- device-memory accounting --------------------------------------------
+
+def test_memory_accounting_on_engine_step(metrics_on):
+    """A cache-miss engine step records the compile-time peak estimate
+    and the live-buffer census split (scope-resident vs transient)."""
+    main, startup, h, batch = _bert_step_programs()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        obs.reset()
+        exe.run(main, feed=batch, fetch_list=[h["loss"]])
+        snap = obs.snapshot()
+    g = snap["gauges"]
+    assert g["hbm.compile_arg_bytes"] > 0
+    assert g["hbm.compile_peak_bytes"] > 0
+    assert g["hbm.live_bytes"] > 0
+    assert g["hbm.resident_bytes"] > 0  # parameters pinned by the scope
+    assert g["hbm.live_bytes"] >= g["hbm.resident_bytes"]
+    assert g["hbm.live_bytes_peak"] >= g["hbm.live_bytes"]
+    assert snap["histograms"]["hbm.compile_peak_bytes_per_exe"]["count"] == 1
+    assert obs.memory.peak_hbm_bytes() > 0
+
+
+def test_memory_pressure_event_edge_triggered(metrics_on):
+    """Crossing PADDLE_TPU_MEMORY_PRESSURE_FRAC of the (overridden)
+    device capacity raises one memory_pressure event per excursion, not
+    one per step."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.observability import memory
+
+    keep_alive = jnp.ones((64,), jnp.float32)  # noqa: F841 nonzero census
+    flags.set_flags({"device_memory_bytes": 1,
+                     "memory_pressure_frac": 0.5})
+    try:
+        memory.reset_peaks()
+        out = memory.record_step_memory(step=1)
+        assert out is not None and out["live_bytes"] > 0
+        assert obs.counter_value("memory.pressure_events") == 1
+        memory.record_step_memory(step=2)   # still over: no re-fire
+        assert obs.counter_value("memory.pressure_events") == 1
+        trips = [s for s in obs.spans() if s.name == "memory_pressure"]
+        assert len(trips) == 1
+        assert trips[0].args["limit_bytes"] == 1
+    finally:
+        flags.reset_flag("device_memory_bytes")
+        flags.reset_flag("memory_pressure_frac")
+
+
+# -- seam-overhead budget CLI --------------------------------------------
+
+def test_marginal_timing_budget_mode():
+    """The asserting --budget-ns mode: a generous budget passes, an
+    impossible (negative) one fails with exit code 1."""
+    from tools.marginal_timing import main as mt_main
+
+    assert mt_main(["--iters", "20000", "--rounds", "2",
+                    "--budget-ns", "1000000"]) == 0
+    assert mt_main(["--iters", "2000", "--rounds", "1",
+                    "--budget-ns=-1"]) == 1
+
+
+# -- tpu_top -------------------------------------------------------------
+
+def test_tpu_top_tail_and_render(tmp_path):
+    from paddle_tpu.observability.export import JsonlSink
+    from tools.tpu_top import SinkTail, TopState, render
+
+    path = str(tmp_path / "m.h0.jsonl")
+    s = JsonlSink(
+        path, rotate_bytes=0, keep=0, host=0,
+        snapshot_fn=lambda: {
+            "counters": {"engine.cache_hit": 3, "engine.cache_miss": 1},
+            "gauges": {"hbm.live_bytes": 512.0,
+                       "hbm.live_bytes_peak": 1024.0},
+            "histograms": {}})
+    tail = SinkTail(path)
+    state = TopState()
+    for step in range(1, 4):
+        s.emit({"t": "span", "name": "step", "ts": step * 1e6,
+                "dur": 2000.0, "tid": 1, "depth": 0,
+                "args": {"step": step}})
+    s.emit_snapshot(force=True)
+    s.flush()
+    for ev in tail.poll():
+        state.consume(ev)
+    assert state.total_steps == 3 and state.host == 0
+    ratio, hits, misses = state.cache_ratio()
+    assert hits == 3 and misses == 1 and ratio == pytest.approx(0.75)
+    screen = render(state, path, now_us=4e6)
+    assert "tpu_top" in screen and "host=h0" in screen
+    assert "steps: 3 total" in screen
+    assert "hit ratio 75.0%" in screen
+    assert "1.0 KiB" in screen          # the live_bytes_peak watermark
+    s.close()
+
+
+def test_tpu_top_tail_survives_torn_lines(tmp_path):
+    from tools.tpu_top import SinkTail
+
+    path = str(tmp_path / "m.jsonl")
+    with open(path, "w") as f:
+        f.write('{"t":"span","name":"step"}\n{"t":"sp')
+        f.flush()
+        tail = SinkTail(path)
+        evs = tail.poll()
+        assert len(evs) == 1            # the torn line is held back
+        f.write('an","name":"x"}\n')
+        f.flush()
+        evs = tail.poll()
+        assert len(evs) == 1 and evs[0]["name"] == "x"

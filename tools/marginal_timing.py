@@ -6,6 +6,19 @@ On the tunneled chip a single dispatch carries ~1-2.5s of
 session-variable overhead that dwarfs ms-scale kernels; the protocol
 times a jitted ``lax.fori_loop`` of data-dependency-chained steps at two
 loop counts and reports (T_hi - T_lo)/Δn, cancelling the fixed overhead.
+
+Also a CLI: the metrics-OFF seam-overhead budget check. The telemetry
+layer's whole contract is that a disabled seam costs one cached-bool
+check (README quotes ~0.3 µs); ``--budget-ns`` turns that promise into
+an asserting gate CI can run::
+
+    python tools/marginal_timing.py --budget-ns 5000
+
+measures the marginal per-call cost of the instrumented no-op seam
+(``obs.inc`` + ``obs.span`` + ``obs.time_block`` with the gate down,
+empty-loop baseline subtracted) and exits 1 if the best-of-rounds
+exceeds the budget — a regression in the off path fails the build
+instead of quietly taxing every engine step.
 """
 
 
@@ -68,6 +81,82 @@ def run_marginal_protocol(variants, args, reps):
     return out
 
 
+def measure_seam_overhead_ns(iters=200000, rounds=5):
+    """Marginal per-call nanoseconds of one metrics-OFF seam: the
+    engine's per-step pattern (counter inc + span ctx + time_block ctx)
+    with the gate down, minus an empty-loop baseline, per iteration.
+    Returns (best_ns, per_round_ns) — best-of-rounds is the asserting
+    number (scheduler noise only ever inflates a round)."""
+    import time
+
+    from paddle_tpu import observability as obs
+
+    was = obs.enabled()
+    obs.set_enabled(False)
+    try:
+        def seam_loop(n):
+            inc, span, time_block = obs.inc, obs.span, obs.time_block
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                inc("seam.counter")
+                with span("seam"):
+                    pass
+                with time_block("seam.ms"):
+                    pass
+            return time.perf_counter_ns() - t0
+
+        def empty_loop(n):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                pass
+            return time.perf_counter_ns() - t0
+
+        seam_loop(1000)  # warm the code paths
+        empty_loop(1000)
+        per_round = []
+        for _ in range(rounds):
+            dt = seam_loop(iters) - empty_loop(iters)
+            per_round.append(max(0.0, dt / iters))
+    finally:
+        obs.set_enabled(True if was else None)
+    return min(per_round), per_round
+
+
+def main(argv=None):
+    import argparse
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.abspath(
+        os.path.join(os.path.dirname(__file__), os.pardir)))
+    p = argparse.ArgumentParser(
+        description="metrics-off telemetry seam overhead check")
+    p.add_argument("--iters", type=int, default=200000,
+                   help="seam calls per timing round (default 200000)")
+    p.add_argument("--rounds", type=int, default=5,
+                   help="timing rounds; best-of is the headline")
+    p.add_argument("--budget-ns", type=float, default=None,
+                   help="fail (exit 1) if the best-of-rounds marginal "
+                   "seam cost exceeds this many nanoseconds per call")
+    args = p.parse_args(argv)
+    best, per_round = measure_seam_overhead_ns(args.iters, args.rounds)
+    out = {
+        "seam_overhead_ns": round(best, 1),
+        "per_round_ns": [round(r, 1) for r in per_round],
+        "iters": args.iters,
+    }
+    if args.budget_ns is not None:
+        out["budget_ns"] = args.budget_ns
+        out["within_budget"] = best <= args.budget_ns
+    print(json.dumps(out))
+    if args.budget_ns is not None and best > args.budget_ns:
+        print("FAIL: metrics-off seam overhead %.1f ns/call exceeds "
+              "budget %.1f ns" % (best, args.budget_ns), file=sys.stderr)
+        return 1
+    return 0
+
+
 def chained_grad_loop(grad_fn, n):
     """One jitted call running ``n`` fwd+bwd steps of ``grad_fn(q, k, v)
     -> (dq, dk, dv)`` chained by a data dependency: the 1e-30*dq term
@@ -86,3 +175,9 @@ def chained_grad_loop(grad_fn, n):
         init = (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
         return lax.fori_loop(0, n, body, init)
     return run
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,19 +1,30 @@
 #!/usr/bin/env python
 """Merge a host-span dump with xplane device aggregates into one
-per-step perf report.
+per-step perf report — or merge a directory of per-worker JSONL
+telemetry dumps into one cross-host report.
 
-The host side comes from ``observability.dump_chrome_trace(path)`` (or
-the ``<profile_path>.trace.json`` stop_profiler writes): every engine
-step is a "step" slice with its trace/transform/lower/compile/run
-children. The device side comes from the jax profiler's xplane dump,
-aggregated per op by tools/xplane_top_ops.py. Together they answer the
-question the throughput number alone cannot: where did each step's wall
-time go — host build (trace/transform/lower), XLA compile, dispatch, or
-device kernels.
+Single-host mode: the host side comes from
+``observability.dump_chrome_trace(path)`` (or the
+``<profile_path>.trace.json`` stop_profiler writes): every engine step
+is a "step" slice with its trace/transform/lower/compile/run children.
+The device side comes from the jax profiler's xplane dump, aggregated
+per op by tools/xplane_top_ops.py. Together they answer the question
+the throughput number alone cannot: where did each step's wall time go
+— host build (trace/transform/lower), XLA compile, dispatch, or device
+kernels.
+
+Multi-host mode (``--merge DIR``): DIR holds the host-tagged JSONL
+sinks each worker streamed (``PADDLE_TPU_METRICS_SINK`` +
+distributed/launch.py's per-rank tagging — ``<base>.h<rank>.jsonl``
+plus rotations). The merge joins them on step number into the table a
+pod run is debugged from: per-step latency skew across workers,
+slowest-worker attribution, and each worker's aggregate HBM
+watermarks.
 
 Usage:
     PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \\
         python tools/perf_report.py HOST_TRACE.json [XPLANE_DIR] [--top N]
+    python tools/perf_report.py --merge DUMP_DIR
 
 With no XPLANE_DIR (or without the xplane protos installed) the report
 is host-only.
@@ -88,6 +99,135 @@ def render_device(xplane_dir, top_n):
     return "\n".join(lines)
 
 
+# -- multi-host merge ------------------------------------------------------
+
+# The HBM watermark gauges a "snap" event carries, in report order.
+HBM_GAUGES = ("hbm.live_bytes_peak", "hbm.compile_peak_bytes",
+              "hbm.device_peak_bytes_in_use")
+
+
+def load_worker_dumps(dump_dir):
+    """Parse every JSONL sink file under ``dump_dir`` (live + rotated),
+    grouped by the host id each event carries:
+    ``{host: {"steps": {step: dur_ms}, "hbm": {gauge: max_bytes},
+    "files": [...], "events": n}}``."""
+    from paddle_tpu.observability.export import iter_events, sink_file_set
+
+    workers = {}
+
+    def w(host):
+        return workers.setdefault(
+            host, {"steps": {}, "hbm": {}, "files": set(), "events": 0})
+
+    for path in sink_file_set(dump_dir):
+        for ev in iter_events(path):
+            host = ev.get("host", 0)
+            rec = w(host)
+            rec["files"].add(os.path.basename(path))
+            rec["events"] += 1
+            kind = ev.get("t")
+            if kind == "span" and ev.get("name") == "step":
+                step = (ev.get("args") or {}).get("step")
+                if step is not None:
+                    # keep the LAST duration per step number (restarted
+                    # counters: later wins, matching the file order)
+                    rec["steps"][int(step)] = ev.get("dur", 0.0) / 1e3
+            elif kind == "snap":
+                gauges = (ev.get("metrics") or {}).get("gauges") or {}
+                for g in HBM_GAUGES:
+                    v = gauges.get(g)
+                    if v is not None:
+                        rec["hbm"][g] = max(rec["hbm"].get(g, 0), int(v))
+    for rec in workers.values():
+        rec["files"] = sorted(rec["files"])
+    return workers
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return ("%.1f %s" % (n, unit)) if unit != "B" \
+                else ("%d B" % n)
+        n /= 1024.0
+    return "%d" % n
+
+
+def render_merge(workers):
+    """The cross-host report: step-skew table, slowest-worker
+    attribution, aggregate HBM watermarks."""
+    hosts = sorted(workers)
+    lines = ["== cross-host: per-step wall (ms) across %d worker(s) =="
+             % len(hosts)]
+    if not hosts:
+        lines.append("(no worker dumps found — were sinks attached via "
+                     "PADDLE_TPU_METRICS_SINK?)")
+        return "\n".join(lines)
+    all_steps = sorted({s for h in hosts for s in workers[h]["steps"]})
+    hdr = ["step"] + ["h%s" % h for h in hosts] + ["skew", "slowest"]
+    lines.append("  ".join("%9s" % c for c in hdr))
+    slowest_count = dict.fromkeys(hosts, 0)
+    for step in all_steps:
+        durs = {h: workers[h]["steps"].get(step) for h in hosts}
+        present = {h: d for h, d in durs.items() if d is not None}
+        row = ["%9d" % step]
+        for h in hosts:
+            row.append("%9.2f" % durs[h] if durs[h] is not None
+                       else "%9s" % "-")
+        if present:
+            skew = max(present.values()) - min(present.values())
+            slow = max(present, key=present.get)
+            slowest_count[slow] += 1
+            row += ["%9.2f" % skew, "%9s" % ("h%s" % slow)]
+        else:
+            row += ["%9s" % "-", "%9s" % "-"]
+        lines.append("  ".join(row))
+    if all_steps:
+        joined = [s for s in all_steps
+                  if all(s in workers[h]["steps"] for h in hosts)]
+        if joined:
+            skews = [max(workers[h]["steps"][s] for h in hosts)
+                     - min(workers[h]["steps"][s] for h in hosts)
+                     for s in joined]
+            lines.append(
+                "steps joined across all workers: %d  mean skew: %.2f ms"
+                "  max skew: %.2f ms"
+                % (len(joined), sum(skews) / len(skews), max(skews)))
+        attribution = ", ".join(
+            "h%s %d/%d" % (h, slowest_count[h], len(all_steps))
+            for h in hosts if slowest_count[h])
+        if attribution:
+            lines.append("slowest-worker attribution: " + attribution)
+    lines.append("")
+    lines.append("== aggregate HBM watermarks ==")
+    short = {g: g[len("hbm."):] for g in HBM_GAUGES}
+    hdr = ["host"] + [short[g] for g in HBM_GAUGES] + ["events", "files"]
+    lines.append("  ".join("%24s" % c if i else "%6s" % c
+                           for i, c in enumerate(hdr)))
+    fleet = {}
+    for h in hosts:
+        rec = workers[h]
+        row = ["%6s" % ("h%s" % h)]
+        for g in HBM_GAUGES:
+            v = rec["hbm"].get(g)
+            if v is not None:
+                fleet[g] = max(fleet.get(g, 0), v)
+            row.append("%24s" % _fmt_bytes(v))
+        row.append("%24d" % rec["events"])
+        row.append("  " + ",".join(rec["files"]))
+        lines.append("  ".join(row))
+    if fleet:
+        lines.append("fleet max: " + "  ".join(
+            "%s=%s" % (short[g], _fmt_bytes(fleet[g]))
+            for g in HBM_GAUGES if g in fleet))
+    return "\n".join(lines)
+
+
+def merge_report(dump_dir):
+    return render_merge(load_worker_dumps(dump_dir))
+
+
 def report(host_path, xplane_dir=None, top_n=15):
     events = load_host_events(host_path)
     rows = per_step_rows(events)
@@ -112,13 +252,24 @@ def main(argv=None):
         "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
     p = argparse.ArgumentParser(
         description="Merged host-span + device-op perf report")
-    p.add_argument("host_trace", help="chrome-trace JSON from "
+    p.add_argument("host_trace", nargs="?", default=None,
+                   help="chrome-trace JSON from "
                    "observability.dump_chrome_trace / stop_profiler")
     p.add_argument("xplane_dir", nargs="?", default=None,
                    help="jax profiler trace dir with .xplane.pb dumps")
     p.add_argument("--top", type=int, default=15,
                    help="device ops to list (default 15)")
+    p.add_argument("--merge", metavar="DIR", default=None,
+                   help="merge a directory of per-worker JSONL telemetry "
+                   "dumps (PADDLE_TPU_METRICS_SINK files) into one "
+                   "cross-host report: per-step latency skew, "
+                   "slowest-worker attribution, aggregate HBM watermarks")
     args = p.parse_args(argv)
+    if args.merge:
+        print(merge_report(args.merge))
+        return 0
+    if not args.host_trace:
+        p.error("either HOST_TRACE or --merge DIR is required")
     print(report(args.host_trace, args.xplane_dir, args.top))
     return 0
 

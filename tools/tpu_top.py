@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""tpu_top — live one-screen summary of a streaming telemetry sink.
+
+Tails the JSONL file a running process streams through
+``PADDLE_TPU_METRICS_SINK`` (observability/export.py JsonlSink) and
+renders a refreshing top-style screen: step rate and step-latency
+percentiles from the "step" spans, cache hit ratio and HBM gauges from
+the periodic "snap" metric snapshots, and the last nan/inf event — the
+at-a-glance view of a training/serving loop without attaching a
+profiler or stopping anything.
+
+Usage:
+    python tools/tpu_top.py /path/metrics.h0.jsonl            # follow
+    python tools/tpu_top.py /path/metrics.h0.jsonl --once     # one shot
+    python tools/tpu_top.py SINK --interval 5 --metrics-lines 20
+
+Rotation-safe: when the live file is atomically rotated away the tail
+drains the freshly rotated segment before following the new live file.
+"""
+import argparse
+import collections
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+from paddle_tpu.observability.metrics import snapshot_text  # noqa: E402
+
+# Step spans kept for the rate/latency window.
+STEP_WINDOW = 512
+# Step-rate lookback (seconds of span timestamps).
+RATE_WINDOW_S = 60.0
+
+
+class SinkTail:
+    """Incremental reader of a live JSONL sink file. Yields complete
+    events only (a torn final line is retried on the next poll) and
+    survives size-based rotation: a shrink means the content moved to
+    ``<path>.<seq>`` — the unread tail of the newest rotation is
+    drained first, then the new live file from offset 0."""
+
+    def __init__(self, path):
+        self.path = path
+        self.offset = 0
+        self._carry = ""
+
+    def _read_from(self, path, offset):
+        try:
+            with open(path, encoding="utf-8") as f:
+                f.seek(offset)
+                data = f.read()
+        except OSError:
+            return "", offset
+        return data, offset + len(data)
+
+    def _newest_rotation(self):
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        base = os.path.basename(self.path) + "."
+        best, best_seq = None, -1
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return None
+        for name in names:
+            if name.startswith(base) and name[len(base):].isdigit():
+                seq = int(name[len(base):])
+                if seq > best_seq:
+                    best, best_seq = os.path.join(d, name), seq
+        return best
+
+    def poll(self):
+        """-> list of new event dicts since the last poll."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        chunks = []
+        if size < self.offset:
+            # rotated away: drain what we had not read from the segment
+            # that now lives under the newest rotation suffix
+            rotated = self._newest_rotation()
+            if rotated:
+                data, _ = self._read_from(rotated, self.offset)
+                chunks.append(data)
+            self.offset = 0
+        data, self.offset = self._read_from(self.path, self.offset)
+        chunks.append(data)
+        text = self._carry + "".join(chunks)
+        lines = text.split("\n")
+        self._carry = lines.pop()  # "" on a complete final line
+        events = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+        return events
+
+
+class TopState:
+    """Rolling state the screen renders from."""
+
+    def __init__(self):
+        self.host = None
+        self.pid = None
+        self.events = 0
+        self.steps = collections.deque(maxlen=STEP_WINDOW)  # (ts_us, dur)
+        self.total_steps = 0
+        self.last_snap = None
+        self.last_snap_ts = None
+        self.last_nan_inf = None
+
+    def consume(self, ev):
+        self.events += 1
+        kind = ev.get("t")
+        if self.host is None and "host" in ev:
+            self.host = ev["host"]
+        if kind == "meta":
+            self.pid = ev.get("pid", self.pid)
+        elif kind == "span":
+            name = ev.get("name")
+            if name == "step":
+                self.steps.append((ev.get("ts", 0.0), ev.get("dur", 0.0)))
+                self.total_steps += 1
+            elif name == "nan_inf_trip":
+                self.last_nan_inf = ev
+        elif kind == "snap":
+            self.last_snap = ev.get("metrics") or {}
+            self.last_snap_ts = ev.get("ts")
+
+    # -- derived ----------------------------------------------------------
+    def step_rate(self):
+        if not self.steps:
+            return 0.0, None, None
+        newest = self.steps[-1][0]
+        horizon = newest - RATE_WINDOW_S * 1e6
+        recent = [(ts, dur) for ts, dur in self.steps if ts >= horizon]
+        if len(recent) < 2:
+            recent = list(self.steps)
+        span_s = max(1e-6, (recent[-1][0] - recent[0][0]) / 1e6)
+        rate = (len(recent) - 1) / span_s if len(recent) > 1 else 0.0
+        durs = sorted(d / 1e3 for _, d in recent)
+        p50 = durs[len(durs) // 2]
+        return rate, p50, durs[-1]
+
+    def cache_ratio(self):
+        snap = self.last_snap or {}
+        c = snap.get("counters") or {}
+        hits = c.get("engine.cache_hit", 0)
+        misses = c.get("engine.cache_miss", 0)
+        total = hits + misses
+        return (hits / total if total else None), hits, misses
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return ("%d B" % n) if unit == "B" else "%.1f %s" % (n, unit)
+        n /= 1024.0
+    return str(n)
+
+
+def render(state, path, metrics_lines=12, now_us=None):
+    """One screen of text from the rolling state."""
+    now_us = time.time_ns() / 1e3 if now_us is None else now_us
+    lines = []
+    head = "tpu_top — %s" % path
+    if state.host is not None:
+        head += "  host=h%s" % state.host
+    if state.pid is not None:
+        head += "  pid=%s" % state.pid
+    head += "  events=%d" % state.events
+    lines.append(head)
+    lines.append("-" * min(96, max(48, len(head))))
+
+    rate, p50, worst = state.step_rate()
+    lines.append(
+        "steps: %d total   rate %.2f/s   p50 %sms   max %sms"
+        % (state.total_steps, rate,
+           "%.2f" % p50 if p50 is not None else "-",
+           "%.2f" % worst if worst is not None else "-"))
+    ratio, hits, misses = state.cache_ratio()
+    lines.append(
+        "cache: hit ratio %s   (%d hits / %d misses)"
+        % ("%.1f%%" % (ratio * 100) if ratio is not None else "-",
+           hits, misses))
+
+    gauges = (state.last_snap or {}).get("gauges") or {}
+    hbm = {k: v for k, v in gauges.items() if k.startswith("hbm.")}
+    if hbm:
+        lines.append("hbm:   live %s (resident %s + transient %s)   "
+                     "peak %s   compile-peak %s"
+                     % (_fmt_bytes(hbm.get("hbm.live_bytes")),
+                        _fmt_bytes(hbm.get("hbm.resident_bytes")),
+                        _fmt_bytes(hbm.get("hbm.transient_bytes")),
+                        _fmt_bytes(hbm.get("hbm.live_bytes_peak")),
+                        _fmt_bytes(hbm.get("hbm.compile_peak_bytes"))))
+        if hbm.get("hbm.device_bytes_limit"):
+            in_use = hbm.get("hbm.device_bytes_in_use")
+            limit = hbm.get("hbm.device_bytes_limit")
+            pct = (100.0 * in_use / limit) if in_use and limit else None
+            lines.append("dev:   in use %s / %s%s"
+                         % (_fmt_bytes(in_use), _fmt_bytes(limit),
+                            "   (%.1f%%)" % pct if pct is not None else ""))
+    else:
+        lines.append("hbm:   (no snapshot with hbm gauges yet)")
+
+    if state.last_nan_inf is not None:
+        args = state.last_nan_inf.get("args") or {}
+        age_s = max(0.0, (now_us - state.last_nan_inf.get("ts", now_us))
+                    / 1e6)
+        lines.append("nan/inf: %s %r at step %s (%d NaN / %d Inf), %.0fs "
+                     "ago" % (args.get("kind", "?"), args.get("var", "?"),
+                              args.get("step", "?"), args.get("nan", 0),
+                              args.get("inf", 0), age_s))
+    else:
+        lines.append("nan/inf: none")
+
+    if state.last_snap and metrics_lines > 0:
+        lines.append("")
+        lines.append("== metrics (Prometheus exposition, truncated) ==")
+        text = snapshot_text(state.last_snap)
+        body = [ln for ln in text.splitlines()
+                if not ln.startswith("# ")]
+        lines.extend(body[:metrics_lines])
+        if len(body) > metrics_lines:
+            lines.append("... %d more series" % (len(body) - metrics_lines))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="live one-screen summary of a streaming telemetry "
+        "sink (PADDLE_TPU_METRICS_SINK JSONL file)")
+    p.add_argument("sink", help="JSONL sink file to tail")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="parse the whole file, print one screen, exit")
+    p.add_argument("--metrics-lines", type=int, default=12,
+                   help="metric series shown in the exposition panel")
+    p.add_argument("--no-clear", action="store_true",
+                   help="do not clear the terminal between refreshes")
+    args = p.parse_args(argv)
+
+    tail = SinkTail(args.sink)
+    state = TopState()
+    try:
+        while True:
+            for ev in tail.poll():
+                state.consume(ev)
+            screen = render(state, args.sink,
+                            metrics_lines=args.metrics_lines)
+            if args.once:
+                print(screen)
+                return 0
+            if not args.no_clear:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(screen)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
