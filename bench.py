@@ -619,6 +619,13 @@ def main():
         # per-model device-memory high-watermark (bytes): BENCH_*.json
         # tracks memory alongside throughput across rounds
         "peak_hbm_bytes": peak_hbm,
+        # resilience-layer activity (rollbacks, gang restarts, checkpoint
+        # retries...): all zero on a healthy bench, so any non-zero value
+        # in BENCH_*.json flags a run whose throughput number absorbed
+        # recovery work
+        "recovery": {k[len("recovery."):]: v
+                     for k, v in sorted(c.items())
+                     if k.startswith("recovery.")},
     }
     if errors:
         result["errors"] = errors

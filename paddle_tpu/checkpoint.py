@@ -31,12 +31,38 @@ import os
 import re
 import shutil
 import threading
+import warnings
 
 import numpy as np
 
 __all__ = ["CheckpointManager"]
 
 _STEP_RE = re.compile(r"^step_(\d+)(?:\.proc(\d+))?$")
+
+
+def _read_manifest(step_dir):
+    """The dir's parsed manifest.json, or None when it is missing,
+    truncated, or unparsable — the signature of a crash mid-write
+    (pre-atomic-rename layouts, torn NFS renames). A None manifest
+    makes the dir invisible to restore/latest_step, so recovery falls
+    back to the previous COMPLETE step instead of raising into the
+    face of a supervisor that is trying to restart the job."""
+    path = os.path.join(step_dir, "manifest.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        warnings.warn(
+            "skipping checkpoint dir %s: corrupt manifest (%s)"
+            % (step_dir, e), RuntimeWarning)
+        from paddle_tpu import observability as obs
+
+        obs.inc("recovery.ckpt_corrupt")
+        obs.event("ckpt.corrupt_manifest", dir=step_dir,
+                  error=str(e)[:200])
+        return None
 
 
 def _covers_global(idx, global_shape):
@@ -156,92 +182,121 @@ class CheckpointManager:
             self.check_error()
 
     def _write(self, step, snapshot):
+        """Background-thread entry: the write attempt runs under the
+        shared retry policy (resilience.retrying) so transient
+        filesystem errors — or an injected ckpt_write fault — cost a
+        backoff-spaced re-attempt, not the checkpoint. Each attempt
+        restarts from a clean tmp dir; only exhaustion surfaces via
+        check_error()."""
+        from paddle_tpu.resilience.faultinject import InjectedFault
+        from paddle_tpu.resilience.retrying import Backoff, retry_call
+
+        def _on_retry(e, attempt, delay):
+            from paddle_tpu import observability as obs
+
+            obs.inc("recovery.ckpt_retry")
+            obs.event("ckpt.write_retry", step=step, attempt=attempt,
+                      error=str(e)[:200])
+
         try:
-            final = self._dirname(step)
-            tmp = os.path.join(self.root,
-                               "." + os.path.basename(final) + ".tmp")
-            shutil.rmtree(tmp, ignore_errors=True)
-            os.makedirs(tmp)
-            pi, pc = self._resolve_proc()
-            manifest = {"step": step, "process": pi,
-                        "process_count": pc, "vars": {}}
-            for name, arr in snapshot.items():
-                shards = getattr(arr, "addressable_shards", None)
-                fname = name.replace("/", "__")
-                if shards is None:
-                    # plain host value: process 0 alone writes it
-                    if pi == 0:
-                        host = np.asarray(arr)
-                        _save_synced(os.path.join(tmp, fname + ".npy"),
-                                     host)
-                        manifest["vars"][name] = {
-                            "global_shape": list(host.shape),
-                            "dtype": str(host.dtype),
-                            "pieces": [{"file": fname + ".npy",
-                                        "index": None}],
-                        }
-                    continue
-                # One writer per DISTINCT slice across the whole mesh:
-                # the lowest process index holding a slice owns it
-                # (replicated arrays and tp-sharded-but-dp-replicated
-                # params are written exactly once cluster-wide, not once
-                # per process)
-                owner = {}
-                for dev, idx in arr.sharding.devices_indices_map(
-                        arr.shape).items():
-                    key = tuple(
-                        (0 if s.start is None else int(s.start),
-                         arr.shape[d] if s.stop is None else int(s.stop))
-                        for d, s in enumerate(idx))
-                    p = getattr(dev, "process_index", 0)
-                    if key not in owner or p < owner[key]:
-                        owner[key] = p
-                written = set()
-                for sh in shards:
-                    key = tuple(map(tuple,
-                                    _slice_index(sh, arr.shape)))
-                    if key in written or owner.get(key) != pi:
-                        continue
-                    written.add(key)
-                    piece = np.asarray(sh.data)       # D2H here
-                    full = _covers_global(key, arr.shape)
-                    pfile = (fname + ".npy" if full
-                             else "%s.shard%d.npy" % (fname,
-                                                      sh.device.id))
-                    _save_synced(os.path.join(tmp, pfile), piece)
-                    manifest["vars"].setdefault(name, {
-                        "global_shape": list(arr.shape),
-                        "dtype": str(piece.dtype),
-                        "pieces": [],
-                    })["pieces"].append(
-                        {"file": pfile,
-                         "index": None if full else list(map(list,
-                                                             key))})
-            with open(os.path.join(tmp, "manifest.json"), "w") as f:
-                json.dump(manifest, f)
-                f.flush()
-                os.fsync(f.fileno())
-            _fsync_dir(tmp)                # file entries durable pre-rename
-            shutil.rmtree(final, ignore_errors=True)
-            os.rename(tmp, final)                     # atomic publish
-            # a re-save of the same step under a DIFFERENT world size
-            # must not leave the other layout's dirs to shadow this one
-            # at restore time (process 0 cleans; peers' same-layout proc
-            # dirs are of course kept)
-            mine = os.path.basename(final)
-            if pi == 0:
-                for d in os.listdir(self.root):
-                    m = _STEP_RE.match(d)
-                    if not m or int(m.group(1)) != step or d == mine:
-                        continue
-                    other_layout = (m.group(2) is not None) != (pc > 1)
-                    if other_layout:
-                        shutil.rmtree(os.path.join(self.root, d),
-                                      ignore_errors=True)
-            _fsync_dir(self.root)                     # durable dir entry
-            self._gc()
+            retry_call(self._write_attempt, step, snapshot,
+                       retry_on=(OSError, InjectedFault), attempts=3,
+                       backoff=Backoff(base=0.05, cap=1.0, jitter=0.5,
+                                       seed=step),
+                       on_retry=_on_retry)
         except Exception as e:                        # noqa: BLE001
             self._error = e
+
+    def _write_attempt(self, step, snapshot):
+        final = self._dirname(step)
+        tmp = os.path.join(self.root,
+                           "." + os.path.basename(final) + ".tmp")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        pi, pc = self._resolve_proc()
+        manifest = {"step": step, "process": pi,
+                    "process_count": pc, "vars": {}}
+        for name, arr in snapshot.items():
+            shards = getattr(arr, "addressable_shards", None)
+            fname = name.replace("/", "__")
+            if shards is None:
+                # plain host value: process 0 alone writes it
+                if pi == 0:
+                    host = np.asarray(arr)
+                    _save_synced(os.path.join(tmp, fname + ".npy"),
+                                 host)
+                    manifest["vars"][name] = {
+                        "global_shape": list(host.shape),
+                        "dtype": str(host.dtype),
+                        "pieces": [{"file": fname + ".npy",
+                                    "index": None}],
+                    }
+                continue
+            # One writer per DISTINCT slice across the whole mesh:
+            # the lowest process index holding a slice owns it
+            # (replicated arrays and tp-sharded-but-dp-replicated
+            # params are written exactly once cluster-wide, not once
+            # per process)
+            owner = {}
+            for dev, idx in arr.sharding.devices_indices_map(
+                    arr.shape).items():
+                key = tuple(
+                    (0 if s.start is None else int(s.start),
+                     arr.shape[d] if s.stop is None else int(s.stop))
+                    for d, s in enumerate(idx))
+                p = getattr(dev, "process_index", 0)
+                if key not in owner or p < owner[key]:
+                    owner[key] = p
+            written = set()
+            for sh in shards:
+                key = tuple(map(tuple,
+                                _slice_index(sh, arr.shape)))
+                if key in written or owner.get(key) != pi:
+                    continue
+                written.add(key)
+                piece = np.asarray(sh.data)       # D2H here
+                full = _covers_global(key, arr.shape)
+                pfile = (fname + ".npy" if full
+                         else "%s.shard%d.npy" % (fname,
+                                                  sh.device.id))
+                _save_synced(os.path.join(tmp, pfile), piece)
+                manifest["vars"].setdefault(name, {
+                    "global_shape": list(arr.shape),
+                    "dtype": str(piece.dtype),
+                    "pieces": [],
+                })["pieces"].append(
+                    {"file": pfile,
+                     "index": None if full else list(map(list,
+                                                         key))})
+        # fault point at the mid-write seam: var files exist, manifest
+        # does not yet — the state a crash here leaves behind is exactly
+        # what _read_manifest's fallback is for
+        from paddle_tpu.resilience.faultinject import fault_point
+
+        fault_point("ckpt_write", step=step)
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)                # file entries durable pre-rename
+        shutil.rmtree(final, ignore_errors=True)
+        os.rename(tmp, final)                     # atomic publish
+        # a re-save of the same step under a DIFFERENT world size
+        # must not leave the other layout's dirs to shadow this one
+        # at restore time (process 0 cleans; peers' same-layout proc
+        # dirs are of course kept)
+        mine = os.path.basename(final)
+        if pi == 0:
+            for d in os.listdir(self.root):
+                m = _STEP_RE.match(d)
+                if not m or int(m.group(1)) != step or d == mine:
+                    continue
+                other_layout = (m.group(2) is not None) != (pc > 1)
+                if other_layout:
+                    shutil.rmtree(os.path.join(self.root, d),
+                                  ignore_errors=True)
+        _fsync_dir(self.root)                     # durable dir entry
+        self._gc()
 
     def _gc(self):
         steps = self.all_steps()
@@ -278,36 +333,43 @@ class CheckpointManager:
 
     # -- restore -----------------------------------------------------------
     def _step_dirs(self, step=None):
-        """{step: [dir, ...]} of COMPLETE checkpoints (every process dir
-        named by the recorded process_count must be present). When a
-        root holds BOTH layouts for one step (re-saved under a different
-        world size and the cleanup raced), the set with the newest
-        manifest wins — never a silent mix."""
+        """{step: [(dir, manifest), ...]} of COMPLETE checkpoints (every
+        process dir named by the recorded process_count must be present,
+        every manifest readable — a missing/truncated/unparsable
+        manifest marks a mid-write crash and hides the dir, see
+        _read_manifest). When a root holds BOTH layouts for one step
+        (re-saved under a different world size and the cleanup raced),
+        the set with the newest manifest wins — never a silent mix."""
         found = {}
         for d in os.listdir(self.root):
             m = _STEP_RE.match(d)
             if not m:
                 continue
-            path = os.path.join(self.root, d, "manifest.json")
-            if not os.path.exists(path):
-                continue
             s = int(m.group(1))
             if step is not None and s != step:
                 continue
+            path = os.path.join(self.root, d)
+            manifest = _read_manifest(path)
+            if manifest is None:
+                continue
             is_proc = m.group(2) is not None
             found.setdefault(s, {}).setdefault(is_proc, []).append(
-                os.path.join(self.root, d))
+                (path, manifest))
         complete = {}
         for s, by_layout in found.items():
             candidates = []
-            for dirs in by_layout.values():
-                with open(os.path.join(sorted(dirs)[0],
-                                       "manifest.json")) as f:
-                    want = json.load(f).get("process_count", 1)
-                if len(dirs) >= want:
+            for entries in by_layout.values():
+                entries = sorted(entries)
+                want = entries[0][1].get("process_count", 1)
+                if len(entries) < want:
+                    continue
+                try:
                     newest = max(os.path.getmtime(
-                        os.path.join(d, "manifest.json")) for d in dirs)
-                    candidates.append((newest, sorted(dirs)))
+                        os.path.join(d, "manifest.json"))
+                        for d, _ in entries)
+                except OSError:
+                    continue        # dir raced away under a peer's gc
+                candidates.append((newest, entries))
             if candidates:
                 complete[s] = max(candidates)[1]
         return complete
@@ -325,21 +387,19 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint under %s" % self.root)
-        dirs = self._step_dirs(step).get(step)
-        if not dirs:
+        entries = self._step_dirs(step).get(step)
+        if not entries:
             raise FileNotFoundError(
                 "checkpoint step %s incomplete or absent under %s"
                 % (step, self.root))
         out = {}
         filled = {}
-        for d in dirs:
-            with open(os.path.join(d, "manifest.json")) as f:
-                manifest = json.load(f)
+        for d, manifest in entries:
             for name, spec in manifest["vars"].items():
                 pieces = spec["pieces"]
                 if name not in out:
                     if (len(pieces) == 1 and pieces[0]["index"] is None
-                            and len(dirs) == 1):
+                            and len(entries) == 1):
                         out[name] = np.load(
                             os.path.join(d, pieces[0]["file"]))
                         continue

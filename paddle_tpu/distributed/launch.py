@@ -1,17 +1,31 @@
-"""Multi-process launcher (reference: python/paddle/distributed/launch.py —
-spawns one worker per device/host setting PADDLE_TRAINER_ID,
-PADDLE_TRAINERS_NUM, PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINER_ENDPOINTS;
-launch.py:24-53). On TPU one process drives all local chips, so
-``nproc_per_node`` defaults to 1 per host; multi-host jobs get the
-coordinator env consumed by parallel.env.init_distributed.
+"""Multi-process launcher + gang supervisor (reference:
+python/paddle/distributed/launch.py — spawns one worker per device/host
+setting PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_CURRENT_ENDPOINT,
+PADDLE_TRAINER_ENDPOINTS; launch.py:24-53). On TPU one process drives
+all local chips, so ``nproc_per_node`` defaults to 1 per host;
+multi-host jobs get the coordinator env consumed by
+parallel.env.init_distributed.
 
-Usage:  python -m paddle_tpu.distributed.launch --nproc 2 train.py [args]
+Supervision (paddle_tpu.resilience): a gang is all-or-nothing — one
+dead worker deadlocks its siblings at the next collective, so the
+supervisor polls ALL workers, and on the FIRST non-zero exit terminates
+the survivors. With a restart budget (``--max-restarts`` /
+``PADDLE_TPU_MAX_RESTARTS``) it then re-launches the whole gang after
+exponential backoff + jitter, bumping ``PADDLE_TPU_RESTART_COUNT`` and
+pointing ``PADDLE_TPU_RECOVERY_CKPT`` at ``--recovery-dir`` so workers
+resume from the latest complete checkpoint (resilience.ResilientDriver
+picks it up). Every restart is a ``recovery.restart`` telemetry
+counter/event.
+
+Usage:  python -m paddle_tpu.distributed.launch --nproc 2 \
+            --max-restarts 3 --recovery-dir /ckpt train.py [args]
 """
 
 import argparse
 import os
 import subprocess
 import sys
+import time
 
 
 def launch_processes(script_args, nproc=1, started_port=6170,
@@ -46,22 +60,118 @@ def launch_processes(script_args, nproc=1, started_port=6170,
     return procs
 
 
+def wait_gang(procs, poll_interval=0.1, term_grace=10.0):
+    """Poll ALL workers until the gang resolves; returns the gang rc.
+
+    The seed launcher's sequential ``p.wait()`` hung forever when a
+    LATER-indexed worker died while an earlier one blocked on it at a
+    collective/barrier. Polling sees the first failure wherever it
+    lands; the surviving gang is then terminated (SIGTERM, ``term_grace``
+    seconds, then SIGKILL) and the first failing worker's rc propagates.
+    All-zero exits return 0."""
+    while True:
+        rcs = [p.poll() for p in procs]
+        failed = next((rc for rc in rcs if rc not in (None, 0)), None)
+        if failed is not None:
+            _terminate_survivors(procs, term_grace)
+            return failed
+        if all(rc == 0 for rc in rcs):
+            return 0
+        time.sleep(poll_interval)
+
+
+def _terminate_survivors(procs, term_grace=10.0):
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = time.monotonic() + term_grace
+    for p in live:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+
+
+def supervise(script_args, nproc=1, started_port=6170,
+              node_ip="127.0.0.1", env_extra=None, max_restarts=None,
+              recovery_dir=None, backoff=None, capture_output=False,
+              on_gang=None):
+    """Launch the gang under supervision; returns the final rc.
+
+    Restarts the WHOLE gang (terminate survivors, backoff, respawn) on
+    each failure while ``max_restarts`` (default: the
+    PADDLE_TPU_MAX_RESTARTS flag) lasts. Each incarnation's workers see
+    ``PADDLE_TPU_RESTART_COUNT`` (0 on the first launch — fault-spec
+    entries fire once per job, not once per incarnation) and, when
+    ``recovery_dir`` is given, ``PADDLE_TPU_RECOVERY_CKPT`` to resume
+    from. ``on_gang(procs, attempt)`` observes each spawned gang
+    (tests)."""
+    from paddle_tpu import flags
+    from paddle_tpu import observability as obs
+    from paddle_tpu.resilience.retrying import Backoff
+
+    if max_restarts is None:
+        max_restarts = int(flags.get_flag("max_restarts"))
+    backoff = backoff if backoff is not None else Backoff(
+        base=0.5, factor=2.0, cap=30.0, jitter=0.5)
+    attempt = 0
+    while True:
+        env = dict(env_extra or {})
+        env["PADDLE_TPU_RESTART_COUNT"] = str(attempt)
+        if recovery_dir:
+            env["PADDLE_TPU_RECOVERY_CKPT"] = recovery_dir
+        procs = launch_processes(script_args, nproc, started_port,
+                                 node_ip, env_extra=env,
+                                 capture_output=capture_output)
+        if on_gang is not None:
+            on_gang(procs, attempt)
+        rc = wait_gang(procs)
+        if rc == 0:
+            return 0
+        if attempt >= max_restarts:
+            obs.event("recovery.giveup", rc=rc, restarts=attempt)
+            return rc
+        delay = backoff.delay(attempt)
+        attempt += 1
+        obs.inc("recovery.restart")
+        obs.event("recovery.restart", rc=rc, attempt=attempt,
+                  backoff_s=round(delay, 3))
+        print("paddle_tpu.launch: gang failed (rc %s); restart %d/%d "
+              "in %.1fs" % (rc, attempt, max_restarts, delay),
+              file=sys.stderr, flush=True)
+        time.sleep(delay)
+
+
 def main():
+    from paddle_tpu import flags
+
     parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     parser.add_argument("--nproc", "--nproc_per_node", type=int, default=1)
     parser.add_argument("--started_port", type=int, default=6170)
     parser.add_argument("--node_ip", default="127.0.0.1")
+    parser.add_argument("--max-restarts", type=int, default=None,
+                        help="gang restart budget (default: the "
+                             "PADDLE_TPU_MAX_RESTARTS flag, 0)")
+    parser.add_argument("--recovery-dir", default=None,
+                        help="checkpoint root exported to workers as "
+                             "PADDLE_TPU_RECOVERY_CKPT (default: the "
+                             "PADDLE_TPU_RECOVERY_CKPT flag)")
     parser.add_argument("script", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.script:
         parser.error("no training script given")
-    procs = launch_processes(args.script, args.nproc, args.started_port,
-                             args.node_ip)
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    sys.exit(rc)
+    recovery_dir = args.recovery_dir or flags.get_flag("recovery_ckpt") \
+        or None
+    sys.exit(supervise(args.script, args.nproc, args.started_port,
+                       args.node_ip, max_restarts=args.max_restarts,
+                       recovery_dir=recovery_dir))
 
 
 if __name__ == "__main__":

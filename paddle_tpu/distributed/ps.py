@@ -569,16 +569,51 @@ class ParameterServer:
 
 # -- client ----------------------------------------------------------------
 
+def _connect_with_retry(ep, deadline=None):
+    """Connect to a pserver endpoint under the shared retry policy
+    (resilience.retrying): a trainer routinely starts BEFORE its
+    pservers bind — or reconnects while a supervised gang restart is
+    still re-binding the port — so connection-refused is a schedule
+    fact, not an error, until the overall deadline says otherwise
+    (reference: the gRPC channel's reconnect backoff the C++ client
+    leans on, grpc_client.cc). The deadline defaults to
+    FLAGS rpc_deadline (60s when the deadline flag is disabled)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.resilience.retrying import Backoff, retry_call
+
+    if deadline is None:
+        deadline = rpc_deadline_seconds() or 60.0
+    host, port = ep.rsplit(":", 1)
+
+    def _on_retry(e, attempt, delay):
+        obs.inc("recovery.rpc_connect_retry")
+        obs.event("rpc.connect_retry", endpoint=ep, attempt=attempt,
+                  error=str(e)[:200])
+
+    try:
+        return retry_call(
+            socket.create_connection, (host, int(port)), timeout=5,
+            retry_on=(ConnectionRefusedError, ConnectionResetError,
+                      ConnectionAbortedError, socket.timeout),
+            deadline=deadline,
+            backoff=Backoff(base=0.05, factor=2.0, cap=2.0, jitter=0.5),
+            on_retry=_on_retry)
+    except OSError as e:
+        raise RpcError(
+            "cannot connect to pserver %s within %.0fs: %s"
+            % (ep, deadline, e)) from e
+
+
 class PSClient:
     """Trainer-side RPC client (reference: distributed/rpc_client.h:32 —
-    AsyncSendVar/AsyncGetVar + barriers, SendComplete)."""
+    AsyncSendVar/AsyncGetVar + barriers, SendComplete). Connects
+    through the shared backoff/deadline policy so trainer-before-server
+    startup ordering and gang restarts resolve instead of crashing."""
 
-    def __init__(self, endpoints):
+    def __init__(self, endpoints, connect_deadline=None):
         self._socks = {}
         for ep in endpoints:
-            host, port = ep.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=60)
-            self._socks[ep] = s
+            self._socks[ep] = _connect_with_retry(ep, connect_deadline)
 
     def _reply(self, ep, expect, idle_ok=False):
         """One reply frame, or a typed RpcError. EOF (server died or shut

@@ -16,6 +16,7 @@ import jax
 from paddle_tpu import observability as obs
 from paddle_tpu.core.types import convert_dtype_to_np
 from paddle_tpu.engine.lowering import BlockProgram, lower_block
+from paddle_tpu.resilience import faultinject
 
 
 def _auto_layout_format():
@@ -183,6 +184,16 @@ class Engine:
                                                  readonly, rng_seed)
         compiled.run_count += 1
 
+        if faultinject.active():
+            # step-seam fault points (one env read when no spec is set):
+            # step_fail raises out of the step; step_nan multiplies the
+            # step's float outputs by NaN so the real nan/inf guard
+            # below trips exactly as a numeric blow-up would
+            faultinject.fault_point("step_fail", step=self._run_counter)
+            if faultinject.fault_point("step_nan", step=self._run_counter):
+                fetches = [_poison_nan(v) for v in fetches]
+                state_out = [_poison_nan(v) for v in state_out]
+
         if obs.enabled():
             if first:
                 # Once per executable: the compile-time peak estimate
@@ -272,6 +283,12 @@ class Engine:
         compiled = self._cache.get(key)
         if compiled is None:
             obs.inc("engine.cache_miss")
+            if faultinject.active():
+                # transient compile failure (a real pod sees these as
+                # coordinator hiccups / OOM-ed compile servers); the
+                # resilience driver retries the step, which re-enters
+                # this cache-miss path
+                faultinject.fault_point("compile")
             with obs.span("trace", block=block_idx, opt_level=opt_level), \
                     obs.time_block("engine.trace_ms"):
                 run_desc = program_desc
@@ -464,6 +481,17 @@ class Engine:
                  if "in_shardings" in jit_kwargs else None)
         return CompiledBlock(bp, jitted, mutated, readonly,
                              in_shardings=in_sh)
+
+
+def _poison_nan(val):
+    """NaN-fill a float array (fault injection's step_nan); non-float
+    values pass through untouched."""
+    import jax.numpy as jnp
+
+    if not hasattr(val, "dtype") or not jnp.issubdtype(
+            jnp.asarray(val).dtype, jnp.floating):
+        return val
+    return jnp.asarray(val) * jnp.nan
 
 
 def _check_finite(named_values, step=None, kind="tensor"):

@@ -111,6 +111,29 @@ DEFS = {
         "memory-pressure check, for backends whose memory_stats() "
         "reports no bytes_limit (e.g. the CPU emulation mesh). "
         "0 = trust the backend / disable the check when unreported."),
+    "max_restarts": (
+        int, 0,
+        "Gang-restart budget of the supervised launcher "
+        "(paddle_tpu.distributed.launch): on the first worker failure "
+        "the supervisor terminates the gang and, while the budget "
+        "lasts, re-launches it after exponential backoff + jitter; "
+        "0 = no restarts (fail fast, but still terminate the "
+        "surviving gang and propagate the rc)."),
+    "fault_spec": (
+        str, "",
+        "Deterministic fault-injection schedule "
+        "(paddle_tpu.resilience.faultinject): ';'-separated "
+        "point@cond:cond entries, e.g. "
+        "'step_nan@7;worker_kill@rank1:step12'. Points: step_nan, "
+        "step_fail, compile, ckpt_write, worker_kill. Empty = no "
+        "faults (the production default; the check is one env read)."),
+    "recovery_ckpt": (
+        str, "",
+        "Checkpoint root a restarted worker resumes from. The "
+        "supervised launcher sets it for every (re)spawn when given "
+        "--recovery-dir; training scripts pass it to a "
+        "CheckpointManager + resilience.ResilientDriver, which "
+        "restores the latest complete step on startup."),
 }
 
 _overrides = {}

@@ -1,0 +1,43 @@
+"""paddle_tpu.resilience — the fault-tolerance layer that turns the
+existing parts (async sharded ``CheckpointManager``, the nan/inf step
+guard, per-worker telemetry sinks) into a system that survives worker
+loss and numeric blow-ups (ISSUE 5; PAPERS.md: TensorFlow's
+checkpoint/restore-centric fault-tolerance design, MLPerf-scale TPU-pod
+preemption-as-routine).
+
+Three pieces:
+
+* ``retrying``    — one shared backoff/deadline/jitter policy
+  (pserver connects, checkpoint writes, gang restarts);
+* ``faultinject`` — deterministic named fault points at the engine
+  seams, scheduled by ``PADDLE_TPU_FAULT_SPEC`` so every recovery path
+  runs in CPU-only tests;
+* ``driver``      — the rollback-on-fault step loop around
+  ``Executor.run`` + a ``CheckpointManager``.
+
+The supervised elastic launcher lives in ``distributed/launch.py``
+(it IS the launcher, grown a supervisor) and reads
+``PADDLE_TPU_MAX_RESTARTS`` / ``PADDLE_TPU_RECOVERY_CKPT``.
+"""
+
+from paddle_tpu.resilience import driver, faultinject, retrying  # noqa: F401
+from paddle_tpu.resilience.driver import (  # noqa: F401
+    FaultBudgetExceeded,
+    ResilientDriver,
+)
+from paddle_tpu.resilience.faultinject import (  # noqa: F401
+    InjectedFault,
+    fault_point,
+)
+from paddle_tpu.resilience.retrying import (  # noqa: F401
+    Backoff,
+    DeadlineExceeded,
+    RetriesExhausted,
+    retry_call,
+)
+
+__all__ = [
+    "Backoff", "DeadlineExceeded", "FaultBudgetExceeded", "InjectedFault",
+    "ResilientDriver", "RetriesExhausted", "driver", "fault_point",
+    "faultinject", "retry_call", "retrying",
+]

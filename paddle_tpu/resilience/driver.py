@@ -1,0 +1,203 @@
+"""Rollback-on-fault step driver: ``Executor.run`` + an attached
+``CheckpointManager`` composed into a training loop that survives
+numeric blow-ups and injected step faults (SURVEY §5: the reference's
+production story is checkpoint-based recovery around Fluid's
+save/load-persistables machinery; TensorFlow likewise treats
+checkpoint/restore fault tolerance as a whole-system requirement —
+PAPERS.md).
+
+The loop contract: batches come from a ``batch_fn(step)`` callable so
+the driver can REWIND — after a fault it restores the last complete
+checkpoint and replays the same batches from there, which (for
+deterministic programs; dropout re-draws per engine run counter) lands
+the run on the identical trajectory an uninterrupted run produces.
+Every recovery is recorded as ``recovery.*`` observability
+counters/events, so a telemetry sink from a chaotic run reads as an
+incident log.
+"""
+
+import numpy as np
+
+from paddle_tpu import observability as obs
+from paddle_tpu.resilience.faultinject import InjectedFault, fault_point
+
+__all__ = ["FaultBudgetExceeded", "ResilientDriver"]
+
+
+class FaultBudgetExceeded(RuntimeError):
+    """More rollbacks than ``max_rollbacks`` — the fault is persistent
+    (every replay re-trips), not transient; chains the last trip."""
+
+
+def _is_recoverable(exc):
+    """Step failures the rollback path owns: injected faults and the
+    engine's nan/inf guard trip. Anything else (user bugs, OOM, shape
+    errors) propagates — rolling back cannot fix a deterministic
+    crash and would just burn the fault budget re-proving it."""
+    if isinstance(exc, InjectedFault):
+        return True
+    return isinstance(exc, RuntimeError) and "check_nan_inf" in str(exc)
+
+
+class ResilientDriver:
+    """Checkpointed training loop with rollback-on-fault.
+
+    ::
+
+        mgr = CheckpointManager(root)
+        drv = ResilientDriver(exe, main, [loss], mgr, scope=scope,
+                              ckpt_interval=10)
+        losses = drv.train(batch_fn, n_steps=200)
+
+    Behaviour per fault (nan/inf trip or injected step fault):
+
+    1. the in-flight async save (if any) is joined — never restore
+       under a half-written checkpoint;
+    2. state rolls back to the latest COMPLETE checkpoint
+       (``io.load_checkpoint``) and the step counter rewinds to it;
+    3. with ``skip_poison_batch=True`` the failing step's batch is
+       excluded from the replay (the poison-pill escape hatch for
+       data-dependent blow-ups; off by default because dropping data
+       changes the trajectory);
+    4. ``recovery.rollback`` counter + event record it.
+
+    ``max_rollbacks`` bounds total recoveries; a run needing more is
+    systematically sick and fails with ``FaultBudgetExceeded``.
+
+    Resume: when the manager's root already holds a checkpoint (the
+    supervised launcher re-spawned this worker after a gang failure,
+    pointing ``PADDLE_TPU_RECOVERY_CKPT`` at the same root), ``train``
+    restores it and continues from that step instead of step 0 —
+    callers run the startup program unconditionally and let the
+    restore overwrite.
+    """
+
+    def __init__(self, executor, program, fetch_list, manager, scope=None,
+                 ckpt_interval=10, max_rollbacks=8, skip_poison_batch=False,
+                 check_nan_inf=True):
+        from paddle_tpu.executor import global_scope
+
+        self.exe = executor
+        self.program = program
+        self.fetch_list = list(fetch_list)
+        self.manager = manager
+        self.scope = scope if scope is not None else global_scope()
+        self.ckpt_interval = int(ckpt_interval)
+        self.max_rollbacks = int(max_rollbacks)
+        self.skip_poison_batch = bool(skip_poison_batch)
+        self.rollbacks = 0
+        if check_nan_inf:
+            # the guard IS the fault detector for numeric blow-ups; the
+            # driver is pointless without one, so it defaults on here
+            # even when the global flag is down
+            executor.engine.check_nan_inf = True
+
+    # -- checkpointing -----------------------------------------------------
+    def _save(self, step, blocking=False):
+        from paddle_tpu import io
+
+        io.save_checkpoint_async(self.manager, step,
+                                 main_program=self.program,
+                                 scope=self.scope, blocking=blocking)
+        obs.inc("recovery.ckpt_saved")
+
+    def resume_step(self):
+        """The step a fresh ``train`` would resume from (latest complete
+        checkpoint), or None when the root holds none."""
+        return self.manager.latest_step()
+
+    def _rollback(self, failed_step, exc):
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise FaultBudgetExceeded(
+                "%d rollbacks exceed the budget of %d (last fault at "
+                "step %d)" % (self.rollbacks, self.max_rollbacks,
+                              failed_step)) from exc
+        # join the in-flight save first: it predates the fault (saves
+        # happen on good steps) but restoring mid-write would race it
+        self.manager.wait()
+        try:
+            self.manager.check_error()
+        except RuntimeError:
+            # a failed BACKGROUND save must not mask the recovery — the
+            # older complete checkpoint is still the rollback target
+            obs.inc("recovery.ckpt_save_failed")
+        from paddle_tpu import io
+
+        step = io.load_checkpoint(self.manager, main_program=self.program,
+                                  scope=self.scope)
+        obs.inc("recovery.rollback")
+        obs.event("recovery.rollback", failed_step=failed_step,
+                  restored_step=step, reason=str(exc)[:200])
+        return step
+
+    # -- the loop ----------------------------------------------------------
+    def train(self, batch_fn, n_steps, start_step=None, on_step=None):
+        """Run steps ``[start, n_steps)``; returns the per-step fetch
+        lists in step order (skipped poison batches are absent).
+
+        ``batch_fn(step) -> feed dict`` must be deterministic in
+        ``step`` — it is re-invoked for replayed steps after a
+        rollback and for the resumed range after a gang restart.
+
+        ``on_step(step, fetches)`` fires after each SUCCESSFUL step
+        (replays included, re-firing for the replayed steps; failed
+        steps never fire). A worker that may be killed and respawned
+        streams its per-step results to durable storage here — the
+        in-memory return value dies with the process."""
+        if start_step is None:
+            start_step = self.resume_step()
+            if start_step is not None:
+                from paddle_tpu import io
+
+                io.load_checkpoint(self.manager,
+                                   main_program=self.program,
+                                   scope=self.scope, step=start_step)
+                obs.inc("recovery.resume")
+                obs.event("recovery.resume", step=start_step)
+            else:
+                start_step = 0
+        if start_step == 0:
+            # the step-0 baseline: the earliest fault must have a
+            # rollback target (blocking — it must exist before step 1)
+            self._save(0, blocking=True)
+        results = {}
+        skip = set()
+        step = start_step
+        while step < n_steps:
+            # worker-liveness fault point: a supervised-launcher test
+            # kills this process here, between steps — the preemption
+            # seam (never mid-device-step in real life either)
+            fault_point("worker_kill", step=step)
+            if step in skip:
+                obs.inc("recovery.batch_skipped")
+                step += 1
+                continue
+            feed = batch_fn(step)
+            try:
+                out = self.exe.run(self.program, feed=feed,
+                                   fetch_list=self.fetch_list,
+                                   scope=self.scope)
+            except Exception as e:  # noqa: BLE001 - filtered below
+                if not _is_recoverable(e):
+                    raise
+                if self.skip_poison_batch:
+                    skip.add(step)
+                step = self._rollback(step, e)
+                continue
+            results[step] = out
+            if on_step is not None:
+                on_step(step, out)
+            step += 1
+            if self.ckpt_interval and step % self.ckpt_interval == 0 \
+                    and step < n_steps:
+                self._save(step)
+        # final checkpoint marks completion (and is what a restarted
+        # gang member resumes past); blocking so the caller returns
+        # with everything durable
+        self._save(n_steps, blocking=True)
+        return [results[s] for s in sorted(results)]
+
+    # convenience for tests / tools
+    def last_values(self, results):
+        return [float(np.asarray(r[0]).reshape(-1)[0]) for r in results]

@@ -1,0 +1,232 @@
+"""Deterministic fault injection at the engine seams.
+
+Large-pod fault tolerance is only trustworthy if every recovery path is
+exercisable WITHOUT real hardware faults (the discipline TensorFlow's
+fault-tolerance design demands and MLPerf-scale pod runs assume —
+PAPERS.md). This module plants named **fault points** at the seams —
+compile (engine cache miss), step run, checkpoint write, worker
+liveness — and a schedule parsed from ``PADDLE_TPU_FAULT_SPEC`` decides
+which hit of which point fires, on which rank, in which incarnation of
+a supervised job. Everything is counter-driven: the same spec against
+the same program replays the same faults.
+
+Spec grammar (';'-separated entries)::
+
+    spec  := entry (';' entry)*
+    entry := point ['@' cond (':' cond)*]
+    cond  := 'step' N   fire when the point's step (or hit count when
+                        the seam passes none) equals N
+           | N          shorthand for stepN
+           | 'rank' N   only on worker rank N (PADDLE_TRAINER_ID)
+           | 'restart' N  only in gang incarnation N (the supervisor
+                          sets PADDLE_TPU_RESTART_COUNT; default 0, so
+                          by default a fault does NOT re-fire after the
+                          supervisor restarts the gang)
+           | 'x' N      fire N times (default 1)
+
+Examples: ``step_nan@7`` — poison the 7th step's outputs with NaN;
+``worker_kill@rank1:step12`` — rank 1 hard-exits at step 12;
+``compile@1;ckpt_write@20`` — the first compile and the step-20
+checkpoint write each fail once (both absorbed by their retry paths).
+
+Registered points and what firing does:
+
+    step_nan     returns True to the engine, which multiplies the
+                 step's float outputs by NaN — the real nan/inf guard
+                 then trips exactly as a numeric blow-up would
+    step_fail    raises InjectedFault out of the step
+    compile      raises InjectedFault from the cache-miss build
+    ckpt_write   raises InjectedFault inside the checkpoint writer's
+                 write attempt (absorbed by its retry; enough
+                 repetitions fail the save)
+    worker_kill  hard process exit with KILLED_EXIT_CODE — no cleanup,
+                 no atexit: the closest a test gets to SIGKILL/preemption
+"""
+
+import os
+
+from paddle_tpu import flags
+
+__all__ = ["InjectedFault", "FaultEntry", "FaultSchedule", "KILLED_EXIT_CODE",
+           "active", "fault_point", "parse_fault_spec", "random_spec",
+           "reset"]
+
+KILLED_EXIT_CODE = 43
+
+#: points that RETURN True instead of raising — the caller applies the
+#: corruption itself (the engine owns the arrays to poison)
+POISON_POINTS = frozenset(["step_nan"])
+
+KNOWN_POINTS = frozenset(
+    ["step_nan", "step_fail", "compile", "ckpt_write", "worker_kill"])
+
+
+class InjectedFault(RuntimeError):
+    """A fault-injection entry fired at a raising fault point."""
+
+    def __init__(self, point, step=None):
+        self.point = point
+        self.step = step
+        super().__init__(
+            "injected fault at point %r (step %s)" % (point, step))
+
+
+class FaultEntry:
+    def __init__(self, point, step=None, rank=None, restart=None, repeat=1):
+        self.point = point
+        self.step = step
+        self.rank = rank
+        self.restart = 0 if restart is None else restart
+        self.repeat = repeat
+        self.fired = 0
+
+    def matches(self, step, rank, restart):
+        if self.fired >= self.repeat:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        if restart != self.restart:
+            return False
+        return self.step is None or step == self.step
+
+    def __repr__(self):
+        conds = []
+        if self.rank is not None:
+            conds.append("rank%d" % self.rank)
+        if self.step is not None:
+            conds.append("step%d" % self.step)
+        if self.restart:
+            conds.append("restart%d" % self.restart)
+        if self.repeat != 1:
+            conds.append("x%d" % self.repeat)
+        return self.point + ("@" + ":".join(conds) if conds else "")
+
+
+def parse_fault_spec(spec):
+    """``spec`` string -> [FaultEntry]; raises ValueError with the
+    offending entry named on any grammar violation."""
+    entries = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        point, _, tail = raw.partition("@")
+        point = point.strip()
+        if point not in KNOWN_POINTS:
+            raise ValueError(
+                "unknown fault point %r in %r (known: %s)"
+                % (point, raw, sorted(KNOWN_POINTS)))
+        kw = {}
+        for cond in (tail.split(":") if tail else []):
+            cond = cond.strip()
+            for prefix, key in (("step", "step"), ("rank", "rank"),
+                                ("restart", "restart"), ("x", "repeat")):
+                if cond.startswith(prefix) and cond[len(prefix):].isdigit():
+                    kw[key] = int(cond[len(prefix):])
+                    break
+            else:
+                if cond.isdigit():           # bare N == stepN
+                    kw["step"] = int(cond)
+                else:
+                    raise ValueError(
+                        "bad fault condition %r in %r" % (cond, raw))
+        entries.append(FaultEntry(point, **kw))
+    return entries
+
+
+def random_spec(seed, n_steps, nproc=1, kinds=("worker_kill", "step_nan")):
+    """A seeded random-but-reproducible chaos schedule: one entry per
+    kind, each at a random step in the middle 80% of the run (early
+    enough to matter, late enough that a checkpoint exists), kills
+    pinned to a random rank. Same seed -> same spec (tools/chaos_run)."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    lo, hi = max(1, n_steps // 10), max(2, (9 * n_steps) // 10)
+    parts = []
+    for kind in kinds:
+        conds = ["step%d" % rng.randint(lo, hi)]
+        if kind == "worker_kill":
+            conds.insert(0, "rank%d" % rng.randrange(nproc))
+        parts.append(kind + "@" + ":".join(conds))
+    return ";".join(parts)
+
+
+class FaultSchedule:
+    """Parsed spec + per-point hit counters. Rank comes from
+    PADDLE_TRAINER_ID, incarnation from PADDLE_TPU_RESTART_COUNT (both
+    read at construction — the launcher sets them per worker spawn)."""
+
+    def __init__(self, spec, rank=None, restart=None):
+        self.spec = spec
+        self.entries = parse_fault_spec(spec)
+        self.rank = (int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+                     if rank is None else int(rank))
+        self.restart = (int(os.environ.get("PADDLE_TPU_RESTART_COUNT", "0"))
+                        if restart is None else int(restart))
+        self._hits = {}
+
+    def check(self, point, step=None):
+        """Record one hit of ``point``; return the FaultEntry that fires
+        now, or None. With no explicit ``step`` from the seam the
+        point's own hit count (1-based) stands in for it."""
+        hits = self._hits.get(point, 0) + 1
+        self._hits[point] = hits
+        eff = hits if step is None else step
+        for e in self.entries:
+            if e.point == point and e.matches(eff, self.rank, self.restart):
+                e.fired += 1
+                return e
+        return None
+
+
+_schedule = None
+
+
+def _get_schedule(spec):
+    global _schedule
+    if _schedule is None or _schedule.spec != spec:
+        _schedule = FaultSchedule(spec)
+    return _schedule
+
+
+def reset():
+    """Drop the cached schedule (test isolation; hit counters restart)."""
+    global _schedule
+    _schedule = None
+
+
+def active():
+    """True when a fault spec is configured — the one-read fast gate the
+    engine checks before paying any schedule work."""
+    return bool(flags.get_flag("fault_spec"))
+
+
+def fault_point(name, step=None):
+    """Declare one hit of fault point ``name``. Returns False when no
+    entry fires; returns True for poison-style points (caller corrupts);
+    raises InjectedFault for failure-style points; never returns for
+    worker_kill."""
+    spec = flags.get_flag("fault_spec")
+    if not spec:
+        return False
+    entry = _get_schedule(spec).check(name, step)
+    if entry is None:
+        return False
+    from paddle_tpu import observability as obs
+
+    obs.inc("faultinject.fired")
+    obs.inc("faultinject.%s.fired" % name)
+    obs.event("faultinject", point=name, step=step, entry=repr(entry))
+    if name == "worker_kill":
+        # flush telemetry, then die the way a preempted worker dies:
+        # immediately, skipping atexit/finally (os._exit) — siblings see
+        # a vanished peer, the supervisor sees a non-zero exit
+        try:
+            obs.flush_sink()
+        except Exception:
+            pass
+        os._exit(KILLED_EXIT_CODE)
+    if name in POISON_POINTS:
+        return True
+    raise InjectedFault(name, step)

@@ -49,6 +49,10 @@ def pytest_addoption(parser):
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running variant excluded from the tier-1 run "
+        "(-m 'not slow')")
     if config.getoption("--verify-programs"):
         os.environ["PADDLE_TPU_VERIFY"] = "1"
         # The engine verifies the desc it actually compiles — the

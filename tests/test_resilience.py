@@ -1,0 +1,522 @@
+"""paddle_tpu.resilience: retry policy, deterministic fault injection,
+rollback-on-fault driver, checkpoint-corruption fallback, and the
+supervised launcher — every recovery path exercised CPU-only with
+injected faults (no real hardware faults required, the discipline the
+fault-tolerance literature demands of checkpoint/restore systems)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.resilience import (Backoff, DeadlineExceeded,
+                                   FaultBudgetExceeded, InjectedFault,
+                                   ResilientDriver, RetriesExhausted,
+                                   faultinject, retry_call)
+from paddle_tpu.resilience.faultinject import (FaultSchedule,
+                                               parse_fault_spec,
+                                               random_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No fault spec leaks across tests (set_flags mirrors into env)."""
+    yield
+    flags.reset_flag("fault_spec")
+    flags.reset_flag("max_restarts")
+    faultinject.reset()
+
+
+def _arm(spec):
+    """Install a fault spec and reset the schedule's hit counters."""
+    flags.set_flags({"fault_spec": spec})
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# retrying
+# ---------------------------------------------------------------------------
+
+class TestRetrying:
+    def test_envelope_schedule(self):
+        b = Backoff(base=0.1, factor=2.0, cap=1.0, jitter=0.0)
+        assert [b.envelope(k) for k in range(6)] == [
+            0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+        # no jitter: delay IS the envelope
+        assert b.delay(3) == 0.8
+
+    def test_jitter_bounds_and_seed_determinism(self):
+        b1 = Backoff(base=1.0, factor=1.0, cap=1.0, jitter=0.5, seed=7)
+        b2 = Backoff(base=1.0, factor=1.0, cap=1.0, jitter=0.5, seed=7)
+        d1 = [b1.delay(k) for k in range(50)]
+        assert d1 == [b2.delay(k) for k in range(50)], \
+            "seeded jitter must replay exactly"
+        assert all(0.5 < d <= 1.0 for d in d1), \
+            "jitter=0.5 delays must land in (envelope/2, envelope]"
+        with pytest.raises(ValueError):
+            Backoff(jitter=1.5)
+
+    def test_attempts_exhausted(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise OSError("nope")
+
+        with pytest.raises(RetriesExhausted) as ei:
+            retry_call(boom, attempts=3,
+                       backoff=Backoff(base=0, jitter=0), sleep=lambda s: 0)
+        assert len(calls) == 3
+        assert isinstance(ei.value.__cause__, OSError)
+
+    def test_deadline_exceeded_and_sleep_clipping(self):
+        now = [0.0]
+        sleeps = []
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            sleeps.append(s)
+            now[0] += s
+
+        def boom():
+            now[0] += 0.4   # each attempt burns 0.4s of fake time
+            raise OSError("down")
+
+        with pytest.raises(DeadlineExceeded):
+            retry_call(boom, deadline=1.0,
+                       backoff=Backoff(base=10.0, jitter=0.0),
+                       sleep=sleep, clock=clock)
+        # the one pre-retry sleep was clipped to the remaining budget,
+        # never the 10s envelope
+        assert sleeps and all(s <= 1.0 for s in sleeps)
+
+    def test_success_after_retries_and_hook(self):
+        state = {"n": 0}
+        seen = []
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise ConnectionRefusedError("not up yet")
+            return "ok"
+
+        out = retry_call(flaky, retry_on=(ConnectionRefusedError,),
+                         attempts=5, backoff=Backoff(base=0, jitter=0),
+                         on_retry=lambda e, a, d: seen.append(a),
+                         sleep=lambda s: 0)
+        assert out == "ok" and state["n"] == 3 and seen == [1, 2]
+
+    def test_non_retryable_propagates(self):
+        with pytest.raises(KeyError):
+            retry_call(lambda: (_ for _ in ()).throw(KeyError("x")),
+                       attempts=3, sleep=lambda s: 0)
+
+    def test_unbounded_loop_rejected(self):
+        with pytest.raises(ValueError):
+            retry_call(lambda: 1)
+
+
+# ---------------------------------------------------------------------------
+# fault spec parsing + schedule semantics
+# ---------------------------------------------------------------------------
+
+class TestFaultSpec:
+    def test_parse(self):
+        entries = parse_fault_spec(
+            "step_nan@7; worker_kill@rank1:step12 ;ckpt_write@3:x2;"
+            "compile")
+        assert [repr(e) for e in entries] == [
+            "step_nan@step7", "worker_kill@rank1:step12",
+            "ckpt_write@step3:x2", "compile"]
+        # bare N == stepN
+        (e,) = parse_fault_spec("step_fail@4")
+        assert e.step == 4 and e.rank is None and e.repeat == 1
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            parse_fault_spec("meteor_strike@3")
+        with pytest.raises(ValueError, match="bad fault condition"):
+            parse_fault_spec("step_nan@sometimes")
+
+    def test_schedule_fires_once_at_step(self):
+        s = FaultSchedule("step_fail@3", rank=0, restart=0)
+        fired = [bool(s.check("step_fail", step=i)) for i in range(1, 6)]
+        assert fired == [False, False, True, False, False]
+        # step 3 again (a replay) must NOT refire a spent entry
+        assert s.check("step_fail", step=3) is None
+
+    def test_hit_count_stands_in_for_step(self):
+        s = FaultSchedule("compile@2", rank=0, restart=0)
+        assert s.check("compile") is None       # hit 1
+        assert s.check("compile") is not None   # hit 2 fires
+        assert s.check("compile") is None
+
+    def test_rank_and_restart_gating(self):
+        spec = "worker_kill@rank1:step5"
+        assert FaultSchedule(spec, rank=0, restart=0).check(
+            "worker_kill", step=5) is None
+        assert FaultSchedule(spec, rank=1, restart=0).check(
+            "worker_kill", step=5) is not None
+        # incarnation 1 (after a gang restart): same entry stays quiet —
+        # the property that makes kill-then-restart terminate
+        assert FaultSchedule(spec, rank=1, restart=1).check(
+            "worker_kill", step=5) is None
+        s = FaultSchedule("step_nan@restart1:step5", rank=0, restart=1)
+        assert s.check("step_nan", step=5) is not None
+
+    def test_repeat(self):
+        s = FaultSchedule("ckpt_write@x3", rank=0, restart=0)
+        fired = [bool(s.check("ckpt_write", step=i)) for i in range(1, 6)]
+        assert fired == [True, True, True, False, False]
+
+    def test_random_spec_reproducible(self):
+        a = random_spec(7, 40, nproc=2)
+        assert a == random_spec(7, 40, nproc=2)
+        assert a != random_spec(8, 40, nproc=2)
+        for e in parse_fault_spec(a):
+            assert 4 <= e.step <= 36, "fault outside the middle 80%"
+            if e.point == "worker_kill":
+                assert e.rank in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# rollback-on-fault driver (real engine, CPU)
+# ---------------------------------------------------------------------------
+
+def _build(lr=0.1):
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="rw1"),
+                            bias_attr=False)
+        pred = fluid.layers.fc(input=h, size=4,
+                               param_attr=fluid.ParamAttr(name="rw2"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    init = {
+        "rw1": np.linspace(-0.4, 0.4, 8 * 16).astype(
+            np.float32).reshape(8, 16),
+        "rw2": np.linspace(0.3, -0.3, 16 * 4).astype(
+            np.float32).reshape(16, 4),
+    }
+    return main, startup, loss, init
+
+
+def _batch_fn(step, batch=16):
+    W = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    rng = np.random.RandomState(1000 + step)
+    xv = rng.randn(batch, 8).astype(np.float32)
+    yv = np.argmax(xv @ W, 1).astype(np.int64).reshape(-1, 1)
+    return {"x": xv, "y": yv}
+
+
+def _drive(ckpt_root, n_steps=12, spec=None, **drv_kw):
+    """Fresh model + scope; optional spec armed AFTER startup so
+    injected faults never hit the init program. Returns (losses, drv)."""
+    main, startup, loss, init = _build()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for k, v in init.items():
+            scope.set(k, v)
+        if spec is not None:
+            _arm(spec)
+        mgr = CheckpointManager(str(ckpt_root))
+        drv = ResilientDriver(exe, main, [loss], mgr, scope=scope,
+                              ckpt_interval=4, **drv_kw)
+        results = drv.train(_batch_fn, n_steps)
+    losses = [float(np.asarray(r[0]).reshape(-1)[0]) for r in results]
+    return losses, drv
+
+
+def test_nan_rollback_matches_fault_free_run(tmp_path):
+    """A NaN blow-up at one step rolls back to the last checkpoint and
+    replays to the IDENTICAL trajectory an uninterrupted run produces
+    (deterministic batches, no dropout)."""
+    clean, drv0 = _drive(tmp_path / "clean")
+    assert drv0.rollbacks == 0
+    # step_nan counts engine runs; every value in [2, 13) lands on a
+    # training step of the faulted run (run 1 is the startup program)
+    chaotic, drv = _drive(tmp_path / "chaos", spec="step_nan@7")
+    assert drv.rollbacks == 1, "the injected NaN never tripped the guard"
+    assert chaotic == clean, \
+        "post-rollback replay diverged from the fault-free trajectory"
+
+
+def test_step_fail_rollback_and_event(tmp_path):
+    from paddle_tpu import observability as obs
+
+    flags.set_flags({"metrics": True})
+    try:
+        clean, _ = _drive(tmp_path / "clean")
+        chaotic, drv = _drive(tmp_path / "chaos", spec="step_fail@5")
+        assert drv.rollbacks == 1
+        assert chaotic == clean
+        snap = obs.snapshot()
+        assert snap["counters"].get("recovery.rollback", 0) >= 1
+        assert snap["counters"].get("faultinject.step_fail.fired") == 1
+    finally:
+        flags.reset_flag("metrics")
+
+
+def test_compile_fault_recovers(tmp_path):
+    """A transient compile failure (cache-miss seam) is one rollback,
+    then the re-entered compile succeeds."""
+    losses, drv = _drive(tmp_path / "c", spec="compile@1")
+    assert drv.rollbacks == 1
+    assert len(losses) == 12
+
+
+def test_persistent_fault_exhausts_budget(tmp_path):
+    with pytest.raises(FaultBudgetExceeded):
+        _drive(tmp_path / "b", spec="step_fail@x99", max_rollbacks=2)
+
+
+def test_skip_poison_batch(tmp_path):
+    """The poison-pill escape hatch: the failing step's batch is dropped
+    from the replay instead of re-run."""
+    losses, drv = _drive(tmp_path / "p", n_steps=12, spec="step_nan@7",
+                         skip_poison_batch=True)
+    assert drv.rollbacks == 1
+    assert len(losses) == 11, "poisoned batch was not skipped"
+
+
+def test_unrecoverable_error_propagates(tmp_path):
+    main, startup, loss, init = _build()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for k, v in init.items():
+            scope.set(k, v)
+        drv = ResilientDriver(exe, main, [loss],
+                              CheckpointManager(str(tmp_path / "u")),
+                              scope=scope)
+        with pytest.raises(RuntimeError, match="before initialization"):
+            # a missing feed is a user bug, not a fault to roll back
+            drv.train(lambda s: {"x": _batch_fn(s)["x"]}, 3)
+    assert drv.rollbacks == 0
+
+
+def test_resume_from_latest_checkpoint(tmp_path):
+    """A second driver over the same root (the respawned-worker path:
+    same program rebuilt in a fresh process, here the same program
+    object in a fresh scope) resumes at the last complete checkpoint,
+    not step 0."""
+    root = tmp_path / "resume"
+    main, startup, loss, init = _build()
+
+    def fresh_scope():
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for k, v in init.items():
+                scope.set(k, v)
+        return exe, scope
+
+    exe, scope = fresh_scope()
+    ResilientDriver(exe, main, [loss], CheckpointManager(str(root)),
+                    scope=scope, ckpt_interval=4).train(_batch_fn, 10)
+
+    exe2, scope2 = fresh_scope()
+    drv = ResilientDriver(exe2, main, [loss],
+                          CheckpointManager(str(root)), scope=scope2,
+                          ckpt_interval=4)
+    assert drv.resume_step() == 10, "final checkpoint missing"
+    results = drv.train(_batch_fn, 14)
+    assert len(results) == 4, "resume re-ran already-completed steps"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening
+# ---------------------------------------------------------------------------
+
+def test_corrupt_manifest_falls_back_to_previous_step(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    for s in (1, 2, 3):
+        mgr.save(s, {"v": np.full((2,), float(s))}, blocking=True)
+    # truncate the newest manifest mid-json (a crash mid-write on a
+    # filesystem without the rename barrier, or plain disk corruption)
+    m = os.path.join(str(tmp_path / "ck"), "step_3", "manifest.json")
+    with open(m, "w") as f:
+        f.write('{"step": 3, "vars": {')
+    with pytest.warns(RuntimeWarning, match="manifest"):
+        assert mgr.latest_step() == 2
+    with pytest.warns(RuntimeWarning):
+        assert mgr.restore()["v"][0] == 2.0
+
+
+def test_missing_manifest_is_skipped_silently(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, {"v": np.ones(2)}, blocking=True)
+    os.makedirs(os.path.join(str(tmp_path / "ck"), "step_5"))
+    assert mgr.latest_step() == 1   # dir without manifest is invisible
+
+
+def test_ckpt_write_fault_absorbed_by_retry(tmp_path):
+    """One injected write failure is retried and the save completes;
+    the retry is a recovery counter, not an error."""
+    from paddle_tpu import observability as obs
+
+    flags.set_flags({"metrics": True})
+    try:
+        _arm("ckpt_write@5")
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(5, {"v": np.ones(2)}, blocking=True)
+        mgr.check_error()           # absorbed: no surfaced error
+        assert mgr.latest_step() == 5
+        assert obs.snapshot()["counters"].get(
+            "recovery.ckpt_retry", 0) >= 1
+    finally:
+        flags.reset_flag("metrics")
+
+
+def test_ckpt_write_fault_persistent_fails_save(tmp_path):
+    _arm("ckpt_write@5:x3")        # one per retry attempt: all 3 fail
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    with pytest.raises(RuntimeError, match="async checkpoint save"):
+        mgr.save(5, {"v": np.ones(2)}, blocking=True)
+    assert mgr.latest_step() is None
+    # no half-published checkpoint either way
+    assert not any(d.startswith("step_")
+                   for d in os.listdir(str(tmp_path / "ck")))
+
+
+# ---------------------------------------------------------------------------
+# supervised launcher (real subprocesses; no jax import in workers)
+# ---------------------------------------------------------------------------
+
+def _py(code):
+    return ["-c", code]
+
+
+def test_wait_gang_no_hang_on_early_rank_failure():
+    """The seed launcher hung in p.wait() on rank 0 while rank 1 was the
+    one that died; wait_gang must see the failure wherever it lands,
+    terminate the survivors, and propagate the rc."""
+    from paddle_tpu.distributed.launch import wait_gang
+
+    procs = [
+        subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(120)"]),
+        subprocess.Popen([sys.executable, "-c",
+                          "import sys; sys.exit(7)"]),
+    ]
+    t0 = time.monotonic()
+    rc = wait_gang(procs, term_grace=5.0)
+    took = time.monotonic() - t0
+    assert rc == 7
+    assert took < 30, "launcher hung %.0fs on the surviving rank" % took
+    assert all(p.poll() is not None for p in procs), \
+        "survivor left running"
+
+
+def test_supervise_zero_restarts_propagates_rc():
+    from paddle_tpu.distributed.launch import supervise
+
+    gangs = []
+    rc = supervise(_py("import sys; sys.exit(3)"), nproc=2,
+                   max_restarts=0, on_gang=lambda p, a: gangs.append(a))
+    assert rc == 3 and gangs == [0]
+
+
+def test_supervise_restarts_until_success(tmp_path):
+    """The gang fails in incarnation 0 and succeeds in incarnation 1;
+    the supervisor must relaunch with PADDLE_TPU_RESTART_COUNT bumped
+    and return 0."""
+    from paddle_tpu.distributed.launch import supervise
+
+    code = ("import os, sys; "
+            "sys.exit(5 if os.environ['PADDLE_TPU_RESTART_COUNT'] == '0' "
+            "else 0)")
+    gangs = []
+    rc = supervise(_py(code), nproc=2, max_restarts=2,
+                   recovery_dir=str(tmp_path),
+                   backoff=Backoff(base=0.01, jitter=0.0),
+                   on_gang=lambda p, a: gangs.append(a))
+    assert rc == 0 and gangs == [0, 1]
+
+
+def test_supervise_budget_exhausted():
+    from paddle_tpu.distributed.launch import supervise
+
+    gangs = []
+    rc = supervise(_py("import sys; sys.exit(9)"), nproc=1,
+                   max_restarts=1, backoff=Backoff(base=0.01, jitter=0.0),
+                   on_gang=lambda p, a: gangs.append(a))
+    assert rc == 9 and gangs == [0, 1]
+
+
+def test_worker_kill_exit_code_reaches_supervisor():
+    """faultinject's worker_kill is an os._exit(43): the supervisor sees
+    exactly KILLED_EXIT_CODE, distinct from a clean or error exit."""
+    from paddle_tpu.distributed.launch import supervise
+
+    code = ("import os; os.environ['PADDLE_TPU_FAULT_SPEC']='worker_kill';"
+            "import sys; sys.path.insert(0, %r);"
+            "from paddle_tpu.resilience.faultinject import fault_point;"
+            "fault_point('worker_kill')" % REPO)
+    rcs = []
+    rc = supervise(_py(code), nproc=1, max_restarts=0,
+                   on_gang=lambda p, a: rcs.append(p))
+    assert rc == faultinject.KILLED_EXIT_CODE
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos smoke (subprocess workers WITH jax; the acceptance
+# criterion: worker kill + NaN trip under the supervisor completes with
+# the fault-free trajectory and records the recovery telemetry)
+# ---------------------------------------------------------------------------
+
+def _run_chaos(tmp_path, extra):
+    cmd = [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+           "--workdir", str(tmp_path)] + extra
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    env["PADDLE_TPU_MAX_RESTARTS"] = "0"   # explicit budgets only
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                         env=env)
+    assert out.returncode == 0, (out.stdout, out.stderr[-3000:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_chaos_run_two_worker_smoke(tmp_path):
+    """One rank-1 kill + one NaN trip, 2 workers, 14 steps: the
+    supervised job completes, every rank's trajectory equals the
+    fault-free run, and the telemetry sinks hold the incident log."""
+    verdict = _run_chaos(tmp_path, [
+        "--nproc", "2", "--steps", "14",
+        "--spec", "worker_kill@rank1:step9;step_nan@5",
+        "--max-restarts", "2", "--started_port", "6391"])
+    assert verdict["ok"], verdict
+    assert verdict["restarts"] >= 1
+    assert any(e.startswith("recovery.") or e == "faultinject"
+               for e in verdict["recovery_events"]), verdict
+
+
+@pytest.mark.slow
+def test_chaos_run_seeded_long(tmp_path):
+    """The long variant: a seeded random schedule over more steps."""
+    verdict = _run_chaos(tmp_path, [
+        "--nproc", "2", "--steps", "40", "--seed", "11",
+        "--started_port", "6441"])
+    assert verdict["ok"], verdict

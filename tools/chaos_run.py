@@ -1,0 +1,279 @@
+"""Chaos acceptance run: training under the supervised launcher with a
+seeded random fault schedule, asserting the job still completes with the
+fault-free result.
+
+Two modes in one file so the supervisor respawns exactly this script:
+
+* default (supervisor): builds a reproducible fault spec
+  (``faultinject.random_spec``) — by default one worker kill plus one
+  NaN trip at random steps — exports it as ``PADDLE_TPU_FAULT_SPEC``,
+  runs ``--nproc`` workers under ``distributed.launch.supervise`` with a
+  restart budget, then verifies every rank finished all steps AND
+  (``--check-parity``) that each rank's loss trajectory matches a
+  fault-free in-process run bit-for-bit. Prints a one-line JSON verdict;
+  exits non-zero on any miss.
+* ``--worker``: one training process — a small MLP + SGD driven by
+  ``resilience.ResilientDriver`` with a per-rank checkpoint root under
+  ``PADDLE_TPU_RECOVERY_CKPT``, writing its per-step losses to
+  ``<result-dir>/rank<i>.json`` on completion. Restart-safe: a respawned
+  worker resumes from its latest complete checkpoint.
+
+Usage::
+
+    python tools/chaos_run.py --steps 30 --nproc 2 --seed 7
+    python tools/chaos_run.py --spec 'step_nan@9' --nproc 1
+
+CPU-only by construction (workers force JAX_PLATFORMS=cpu); the point
+is recovery-path coverage, not throughput.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CKPT_INTERVAL = 5
+
+
+def build(lr=0.1):
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="cw1"),
+                            bias_attr=False)
+        pred = fluid.layers.fc(input=h, size=4,
+                               param_attr=fluid.ParamAttr(name="cw2"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    init = {
+        "cw1": np.linspace(-0.4, 0.4, 16 * 16).astype(
+            np.float32).reshape(16, 16),
+        "cw2": np.linspace(0.3, -0.3, 16 * 4).astype(
+            np.float32).reshape(16, 4),
+    }
+    return main, startup, loss, init
+
+
+def batch_fn(step, batch=16, seed=0):
+    """Deterministic in ``step`` — the rewind/replay contract the
+    ResilientDriver requires for exact post-recovery parity."""
+    import numpy as np
+
+    W = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    rng = np.random.RandomState(seed * 100003 + step)
+    xv = rng.randn(batch, 16).astype(np.float32)
+    yv = np.argmax(xv @ W, 1).astype(np.int64).reshape(-1, 1)
+    return {"x": xv, "y": yv}
+
+
+def train_losses(n_steps, ckpt_root, rank=0, max_rollbacks=8,
+                 on_step=None):
+    """Train the probe model under a ResilientDriver; returns the
+    per-step scalar losses. Faults (if any are scheduled) fire through
+    the engine's real seams; recovery is the driver's problem."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.resilience import ResilientDriver
+
+    main, startup, loss, init = build()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    for k, v in init.items():
+        scope.set(k, v)
+    mgr = CheckpointManager(ckpt_root, max_to_keep=4)
+    drv = ResilientDriver(exe, main, [loss], mgr, scope=scope,
+                          ckpt_interval=CKPT_INTERVAL,
+                          max_rollbacks=max_rollbacks)
+    results = drv.train(lambda s: batch_fn(s, seed=rank), n_steps,
+                        on_step=on_step)
+    return [float(np.asarray(r[0]).reshape(-1)[0]) for r in results]
+
+
+def reassemble_steps(steps_path, n_steps):
+    """Per-step JSONL (possibly spanning incarnations and rollback
+    replays) -> full loss trajectory, last write per step winning.
+    Returns None when any step is missing."""
+    got = {}
+    try:
+        with open(steps_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line from a kill mid-write
+                got[rec["step"]] = rec["loss"]
+    except OSError:
+        return None
+    if set(got) != set(range(n_steps)):
+        return None
+    return [got[s] for s in range(n_steps)]
+
+
+def run_worker(args):
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    root = os.environ.get("PADDLE_TPU_RECOVERY_CKPT") or os.path.join(
+        args.result_dir, "ckpt")
+    # stream every step's loss to an append-only per-rank JSONL: a
+    # killed incarnation's in-memory results die with it, but this file
+    # survives the respawn, so the full trajectory reassembles
+    steps_path = os.path.join(args.result_dir, "rank%d.steps.jsonl" % rank)
+    with open(steps_path, "a") as steps_f:
+        def on_step(step, out):
+            steps_f.write(json.dumps(
+                {"step": step,
+                 "loss": float(np.asarray(out[0]).reshape(-1)[0])}) + "\n")
+            steps_f.flush()
+
+        train_losses(args.steps, os.path.join(root, "rank%d" % rank),
+                     rank=rank, on_step=on_step)
+    losses = reassemble_steps(steps_path, args.steps)
+    if losses is None:
+        print("chaos_run worker %d: incomplete step log" % rank,
+              file=sys.stderr)
+        return 1
+    out = os.path.join(args.result_dir, "rank%d.json" % rank)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(losses, f)
+    os.replace(tmp, out)
+    return 0
+
+
+def run_supervisor(args):
+    from paddle_tpu import flags
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.launch import supervise
+    from paddle_tpu.resilience.faultinject import random_spec
+
+    flags.set_flags({"metrics": True})
+    spec = args.spec if args.spec is not None else random_spec(
+        args.seed, args.steps, nproc=args.nproc)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_run_")
+    result_dir = os.path.join(workdir, "results")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    os.makedirs(result_dir, exist_ok=True)
+    sink = os.path.join(workdir, "metrics.jsonl")
+    # kills count against the restart budget; everything else the
+    # workers absorb in-process
+    max_restarts = args.max_restarts if args.max_restarts is not None \
+        else max(2, spec.count("worker_kill") + 1)
+    env_extra = {
+        "PADDLE_TPU_FAULT_SPEC": spec,
+        "PADDLE_TPU_METRICS": "1",
+        "PADDLE_TPU_METRICS_SINK": sink,
+    }
+    worker_cmd = [os.path.abspath(__file__), "--worker",
+                  "--steps", str(args.steps), "--result-dir", result_dir]
+    rc = supervise(worker_cmd, nproc=args.nproc, env_extra=env_extra,
+                   max_restarts=max_restarts, recovery_dir=ckpt_dir,
+                   started_port=args.started_port)
+
+    verdict = {"spec": spec, "rc": rc, "workdir": workdir,
+               "restarts": obs.snapshot()["counters"].get(
+                   "recovery.restart", 0)}
+    problems = []
+    if rc != 0:
+        problems.append("gang failed with rc %s" % rc)
+    ranks = {}
+    for r in range(args.nproc):
+        path = os.path.join(result_dir, "rank%d.json" % r)
+        try:
+            with open(path) as f:
+                ranks[r] = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append("rank %d wrote no result (%s)" % (r, e))
+            continue
+        if len(ranks[r]) != args.steps:
+            problems.append("rank %d finished %d/%d steps"
+                            % (r, len(ranks[r]), args.steps))
+    # the workers' telemetry sinks ARE the incident log: recoveries
+    # must have been recorded there, not just survived. Per-worker
+    # sinks are host-tagged (metrics.jsonl -> metrics.h<rank>.jsonl).
+    recoveries = []
+    for path in glob.glob(os.path.splitext(sink)[0] + "*"):
+        with open(path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if str(ev.get("name", "")).startswith(
+                        ("recovery.", "faultinject")):
+                    recoveries.append(ev.get("name"))
+    verdict["recovery_events"] = sorted(set(recoveries))
+    if spec and not recoveries and verdict["restarts"] == 0:
+        problems.append("no recovery events recorded for spec %r" % spec)
+    if args.check_parity and not problems:
+        for r, got in ranks.items():
+            want = train_losses(args.steps,
+                                os.path.join(workdir, "ref%d" % r), rank=r)
+            if got != want:
+                diff = next(i for i, (a, b) in enumerate(zip(got, want))
+                            if a != b)
+                problems.append(
+                    "rank %d diverged from the fault-free run at step %d"
+                    % (r, diff))
+    verdict["ok"] = not problems
+    if problems:
+        verdict["problems"] = problems
+    print(json.dumps(verdict))
+    return 0 if not problems else 1
+
+
+def main():
+    parser = argparse.ArgumentParser("chaos_run")
+    parser.add_argument("--worker", action="store_true",
+                        help="internal: run as one supervised worker")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--nproc", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=0,
+                        help="fault-schedule seed (same seed, same chaos)")
+    parser.add_argument("--spec", default=None,
+                        help="explicit fault spec; overrides --seed")
+    parser.add_argument("--max-restarts", type=int, default=None,
+                        help="default: worker kills in the spec + 1")
+    parser.add_argument("--workdir", default=None,
+                        help="default: fresh temp dir, kept for forensics")
+    parser.add_argument("--result-dir", default=None)
+    parser.add_argument("--started_port", type=int, default=6280)
+    parser.add_argument("--check-parity", action="store_true",
+                        default=True)
+    parser.add_argument("--no-check-parity", dest="check_parity",
+                        action="store_false")
+    args = parser.parse_args()
+    if args.worker:
+        return run_worker(args)
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return run_supervisor(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
