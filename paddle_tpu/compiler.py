@@ -151,10 +151,9 @@ class CompiledProgram:
             return_numpy=return_numpy,
             seed=getattr(self._program, "random_seed", 0) or 0,
             amp=getattr(self._program, "_amp", False),
-            cache_key_extra=(
-                "spmd", tuple(mesh.shape.items()), id(self._shard_rules),
-                self._data_axes,
-            ),
+            # no cache_key_extra: the engine itself keys on mesh
+            # identity + rule-table signature + data axes, so equal
+            # tables share an executable and different meshes never do
             mesh=mesh,
             shard_rules=self._shard_rules,
             data_axes=self._data_axes,

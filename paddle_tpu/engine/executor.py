@@ -163,6 +163,18 @@ class Engine:
                        for v, s in zip(mutated, mut_sh)]
             readonly = [_globalize(v, s)
                         for v, s in zip(readonly, ro_sh)]
+        elif mesh is not None:
+            # Single-process mesh: jit reshards undonated args freely,
+            # but the DONATED state buffers must already match the
+            # declared in_shardings — a live array laid out by a
+            # previous rule table trips pjit's donation check otherwise
+            # (the "two rule tables, one scope" sequence). Reshard only
+            # on mismatch; steady-state steps pass through untouched.
+            _, mut_sh, _ = compiled.in_shardings
+            mutated = [
+                jax.device_put(v, s)
+                if isinstance(v, jax.Array) and v.sharding != s else v
+                for v, s in zip(mutated, mut_sh)]
 
         self._run_counter += 1
         # The PRNG key is derived INSIDE the jitted function from two scalar
@@ -266,6 +278,20 @@ class Engine:
             opt_level = int(flags.get_flag("opt_level"))
         else:
             opt_level = int(opt_level)
+        # Mesh-targeted compiles key on the mesh identity (axis
+        # names/sizes + device ids) and the sharding-rule table, so the
+        # same program compiled for two meshes — or two rule tables —
+        # yields two executables; the no-mesh path keys on None and
+        # keeps hitting its existing entry.
+        if mesh is not None:
+            from paddle_tpu.parallel.mesh import mesh_signature
+
+            mesh_key = (mesh_signature(mesh),
+                        shard_rules.signature()
+                        if shard_rules is not None else None,
+                        tuple(data_axes))
+        else:
+            mesh_key = None
         key = (
             program_desc.cached_fingerprint(),
             block_idx,
@@ -279,6 +305,7 @@ class Engine:
             remat_segments,
             cache_key_extra,
             opt_level,
+            mesh_key,
         )
         compiled = self._cache.get(key)
         if compiled is None:
@@ -405,7 +432,13 @@ class Engine:
                 else readonly_vals[readonly_idx[n]]
                 for n in bp.state_in_names
             ]
-            return fn(feed_values, state_values, rng_key)
+            # runs at jit-trace time: mesh-aware op lowerings (the
+            # shard_map flash-attention dispatch) read the ambient
+            # (mesh, data_axes) instead of a threaded argument
+            from paddle_tpu.parallel.mesh import spmd_lowering
+
+            with spmd_lowering(mesh, data_axes):
+                return fn(feed_values, state_values, rng_key)
 
         donate = (1,) if (donate_state and mutated) else ()
         jit_kwargs = {}
@@ -449,10 +482,16 @@ class Engine:
             def state_sharding(name):
                 if shard_rules is None:
                     return rep
-                spec = shard_rules.spec_for(name)
+                vd = block.find_var_recursive(name)
+                # a trainable param with a rule table but no matching
+                # rule silently replicates — surface that (once per
+                # name) as an observability event + warning
+                spec = shard_rules.spec_for(
+                    name, warn_unmatched=bool(
+                        vd is not None and getattr(vd, "is_parameter",
+                                                   False)))
                 if not len(spec):
                     return rep
-                vd = block.find_var_recursive(name)
                 ndim = (len(vd.shape) if vd is not None
                         and vd.shape is not None else None)
                 # a rule matching a lower-rank var (e.g. an optimizer's

@@ -142,7 +142,8 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True, accumulate_steps=1, remat_segments=0,
-            verify=None, opt_level=None):
+            verify=None, opt_level=None, mesh=None, shard_rules=None,
+            data_axes=("dp",)):
         """``accumulate_steps=k`` runs the feed as k micro-batches through a
         compiled scan with one optimizer update on the averaged gradients —
         the batch-merge capability (reference:
@@ -167,6 +168,16 @@ class Executor:
         executable — 0 off, 1 attention-pattern→flash rewrite, 2 + fusion
         / constant folding / CSE (see paddle_tpu.analysis.transforms).
 
+        ``mesh``/``shard_rules``/``data_axes`` select the GSPMD path on a
+        plain Program: the step is jitted with ``jax.sharding`` in/out
+        specs over the mesh — feeds batch-sharded over ``data_axes``,
+        state laid out per the ``parallel.sharding.ShardingRules`` table
+        (replicated when no rule matches) — and XLA's partitioner
+        derives every gradient collective in-graph (no pserver
+        round-trip). Default: the ``PADDLE_TPU_MESH`` flag when set,
+        else single-device compilation. A 1-device mesh is bit-identical
+        to no mesh.
+
         Every run is wrapped in a top-level ``executor.run`` telemetry
         span when ``PADDLE_TPU_METRICS`` is up (paddle_tpu.observability)
         — the outermost host lane of the step timeline."""
@@ -179,11 +190,13 @@ class Executor:
                 scope=scope, return_numpy=return_numpy,
                 accumulate_steps=accumulate_steps,
                 remat_segments=remat_segments, verify=verify,
-                opt_level=opt_level)
+                opt_level=opt_level, mesh=mesh, shard_rules=shard_rules,
+                data_axes=data_axes)
 
     def _run_impl(self, program=None, feed=None, fetch_list=None,
                   scope=None, return_numpy=True, accumulate_steps=1,
-                  remat_segments=0, verify=None, opt_level=None):
+                  remat_segments=0, verify=None, opt_level=None,
+                  mesh=None, shard_rules=None, data_axes=("dp",)):
         from paddle_tpu.compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()
@@ -216,6 +229,13 @@ class Executor:
         fetch_names = [
             f.name if hasattr(f, "name") else str(f) for f in fetch_list
         ]
+        if mesh is None:
+            # zero-code-change entry: PADDLE_TPU_MESH selects the GSPMD
+            # path for every plain run (startup programs included —
+            # their state lands pre-sharded per the same rules)
+            from paddle_tpu.parallel.mesh import mesh_from_flag
+
+            mesh = mesh_from_flag()
         return self.engine.run_block(
             program.desc,
             0,
@@ -230,4 +250,7 @@ class Executor:
             remat_segments=remat_segments,
             verify=verify,
             opt_level=opt_level,
+            mesh=mesh,
+            shard_rules=shard_rules,
+            data_axes=tuple(data_axes),
         )

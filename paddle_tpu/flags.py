@@ -111,6 +111,24 @@ DEFS = {
         "memory-pressure check, for backends whose memory_stats() "
         "reports no bytes_limit (e.g. the CPU emulation mesh). "
         "0 = trust the backend / disable the check when unreported."),
+    "mesh": (
+        str, "",
+        "Device mesh for the GSPMD executor path, as 'axis=size' pairs "
+        "('dp=8', 'dp=4,tp=2'; one axis may be -1 = all remaining "
+        "devices). When set, plain Executor.run jits the step with "
+        "jax.sharding specs over this mesh — batch sharded over the "
+        "data axes, state per the sharding rules (replicated without "
+        "rules) — with XLA deriving every gradient collective. Empty = "
+        "single-device compilation (the default; bit-identical to a "
+        "1-device mesh)."),
+    "dist_strategy": (
+        str, "",
+        "Distributed-training transport ParallelExecutor and the "
+        "distribute transpiler select: '' or 'dp' = in-process SPMD "
+        "data parallelism over local devices (the default), 'mesh' = "
+        "GSPMD over the PADDLE_TPU_MESH mesh with in-graph psum "
+        "gradient reduction (no pserver round-trip), 'pserver'/'nccl2' "
+        "= the legacy transpiler transports."),
     "max_restarts": (
         int, 0,
         "Gang-restart budget of the supervised launcher "

@@ -848,27 +848,84 @@ def flash_dispatch_ok(tq, tk):
             and tk >= _flash_min_seq())
 
 
-def dispatch_attention_lse(q, k, v, causal=False, scale=None, seq_lens=None,
-                           dropout_rate=0.0, seed=0, force_pallas=None,
-                           raw_lse=False):
-    """THE shared (out, lse) attention dispatch: the Pallas kernels when
-    ``flash_dispatch_ok`` (block table + interpret flag resolved here, in
-    exactly one place), the XLA composition otherwise. ``fused_attention``,
-    the fused_attention op lowering, and the registered grad op's
-    recompute fallback all route through this function, so the forward a
-    gradient differentiates can never silently diverge from the forward
-    that produced the saved Out.
+# --- SPMD (shard_map) wrapping ---------------------------------------------
+# When a block is being traced for a mesh (engine/executor.py sets the
+# parallel.mesh.spmd_lowering context), the attention dispatch and the
+# direct flash backward wrap themselves in shard_map over the mesh's
+# data-parallel and tensor axes — attention is independent per
+# (batch, head), so splitting those dims is exact, each shard runs the
+# Pallas kernels at local shape, and XLA never tries to partition a
+# pallas_call it cannot see into. Same construction as
+# parallel/ring_attention.py's sp-axis ring (which remains the sequence
+# axis story; these wraps leave the sequence dim whole).
 
-    ``raw_lse=True`` returns the logsumexp in the kernel's native tiling
-    carried as ``[B, H, Tq, _LSE_LANES]`` float32 (a major-dim-only
-    reshape of the kernel's [B*H, Tq, LANES] — layout-preserving, and
-    the leading dim keeps the build-time batch sentinel intact) instead
-    of the public ``[B, H, Tq]``. The fused_attention op saves it this
-    way so the backward kernels read it with zero relayout (the
-    [B,H,T] <-> [B*H,T,1] round trip doesn't commute with TPU tiling;
-    the round-5 seq-2048 trace showed 12 x ~0.08 ms/step of lse layout
-    copies). Only meaningful on the forward-only (op) path — the
-    custom_vjp keeps the public form."""
+try:
+    from jax import shard_map as _shard_map_raw
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+
+def _shard_map(body, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across the jax rename
+    (check_vma today, check_rep before)."""
+    try:
+        return _shard_map_raw(body, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+    except TypeError:
+        return _shard_map_raw(body, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+
+def _spmd_attention_axes(B, H):
+    """(mesh, batch_axes, head_axis) for the active SPMD lowering
+    context, or None when no wrap applies: no context, 1-way axes, or
+    indivisible batch/head dims (each falls back to the unwrapped
+    single-device trace — a 1-device mesh is bit-identical by
+    construction)."""
+    from paddle_tpu.parallel.mesh import current_spmd
+
+    spmd = current_spmd()
+    if spmd is None:
+        return None
+    mesh, data_axes = spmd
+    batch_axes = tuple(a for a in data_axes if a in mesh.axis_names)
+    bsz = 1
+    for a in batch_axes:
+        bsz *= mesh.shape[a]
+    if not (bsz > 1 and B % bsz == 0):
+        batch_axes = ()
+    head_axis = None
+    if ("tp" in mesh.axis_names and "tp" not in batch_axes
+            and mesh.shape["tp"] > 1 and H % mesh.shape["tp"] == 0):
+        head_axis = "tp"
+    if not batch_axes and head_axis is None:
+        return None
+    return mesh, batch_axes, head_axis
+
+
+def _batch_spec_entry(batch_axes):
+    if not batch_axes:
+        return None
+    return batch_axes if len(batch_axes) > 1 else batch_axes[0]
+
+
+def _shard_seed(seed, mesh, batch_axes, head_axis):
+    """Per-shard dropout seed: fold the linear shard index in so shards
+    draw decorrelated masks (the kernel's hash RNG indexes by LOCAL
+    (b, q, k) coordinates, which repeat across shards). Deterministic in
+    (seed, shard), and identical in the forward and backward wraps, so
+    the backward kernels still regenerate the forward's exact mask."""
+    idx = jnp.int32(0)
+    for a in tuple(batch_axes) + ((head_axis,) if head_axis else ()):
+        idx = idx * jnp.int32(mesh.shape[a]) + jax.lax.axis_index(
+            a).astype(jnp.int32)
+    return jnp.asarray(seed, jnp.int32) + idx * jnp.int32(1000003)
+
+
+def _dispatch_local(q, k, v, causal, scale, seq_lens, dropout_rate, seed,
+                    force_pallas, raw_lse):
+    """Single-device (or per-shard) dispatch core of
+    ``dispatch_attention_lse``."""
     Tq, Tk = q.shape[2], k.shape[2]
     B, H = q.shape[0], q.shape[1]
     bq, bk = pick_block(Tq, q.dtype), pick_block(Tk, q.dtype)
@@ -890,6 +947,119 @@ def dispatch_attention_lse(q, k, v, causal=False, scale=None, seq_lens=None,
     if raw_lse:
         lse = jnp.broadcast_to(lse[..., None], (B, H, Tq, _LSE_LANES))
     return out, lse
+
+
+def dispatch_attention_lse(q, k, v, causal=False, scale=None, seq_lens=None,
+                           dropout_rate=0.0, seed=0, force_pallas=None,
+                           raw_lse=False):
+    """THE shared (out, lse) attention dispatch: the Pallas kernels when
+    ``flash_dispatch_ok`` (block table + interpret flag resolved here, in
+    exactly one place), the XLA composition otherwise. ``fused_attention``,
+    the fused_attention op lowering, and the registered grad op's
+    recompute fallback all route through this function, so the forward a
+    gradient differentiates can never silently diverge from the forward
+    that produced the saved Out.
+
+    Under an active SPMD lowering context (the engine tracing a block
+    for a mesh) the whole dispatch additionally wraps itself in
+    ``shard_map`` over the mesh's data axes (batch dim) and ``tp`` axis
+    (head dim) — exact per-(batch, head) decomposition, so sharded
+    models get the flash kernels per shard instead of an XLA-partitioned
+    approximation of the custom call.
+
+    ``raw_lse=True`` returns the logsumexp in the kernel's native tiling
+    carried as ``[B, H, Tq, _LSE_LANES]`` float32 (a major-dim-only
+    reshape of the kernel's [B*H, Tq, LANES] — layout-preserving, and
+    the leading dim keeps the build-time batch sentinel intact) instead
+    of the public ``[B, H, Tq]``. The fused_attention op saves it this
+    way so the backward kernels read it with zero relayout (the
+    [B,H,T] <-> [B*H,T,1] round trip doesn't commute with TPU tiling;
+    the round-5 seq-2048 trace showed 12 x ~0.08 ms/step of lse layout
+    copies). Only meaningful on the forward-only (op) path — the
+    custom_vjp keeps the public form."""
+    spmd = _spmd_attention_axes(q.shape[0], q.shape[1])
+    if spmd is None:
+        return _dispatch_local(q, k, v, causal, scale, seq_lens,
+                               dropout_rate, seed, force_pallas, raw_lse)
+    mesh, batch_axes, head_axis = spmd
+    from jax.sharding import PartitionSpec as P
+
+    bspec = _batch_spec_entry(batch_axes)
+    qspec = P(bspec, head_axis, None, None)
+    out_specs = (qspec,
+                 P(bspec, head_axis, None, None) if raw_lse
+                 else P(bspec, head_axis, None))
+    seed_in = jnp.asarray(seed, jnp.int32)
+
+    def body(q_, k_, v_, seed_, lens_):
+        if dropout_rate > 0.0:
+            seed_ = _shard_seed(seed_, mesh, batch_axes, head_axis)
+        return _dispatch_local(q_, k_, v_, causal, scale, lens_,
+                               dropout_rate, seed_, force_pallas, raw_lse)
+
+    if seq_lens is not None:
+        fn = _shard_map(
+            body, mesh=mesh,
+            in_specs=(qspec, qspec, qspec, P(), P(bspec)),
+            out_specs=out_specs)
+        return fn(q, k, v, seed_in, seq_lens)
+    fn = _shard_map(
+        lambda q_, k_, v_, s_: body(q_, k_, v_, s_, None), mesh=mesh,
+        in_specs=(qspec, qspec, qspec, P()),
+        out_specs=out_specs)
+    return fn(q, k, v, seed_in)
+
+
+def flash_backward_spmd(q, k, v, out, lse_k, g, seq_lens, seed, causal,
+                        scale, rate, block_q, block_k, interpret,
+                        dq_blocks=None, dkv_blocks=None):
+    """``_flash_backward`` for the registered grad op, shard_mapped over
+    the active mesh's data/tp axes when an SPMD lowering context is up
+    (per-(batch, head) independence makes the wrap exact — the same
+    decomposition the forward dispatch used, so the saved Out/Lse shards
+    line up); plain direct call otherwise. ``lse_k`` arrives in the
+    kernel's [B*H, Tq, LANES] layout; the wrap splits its leading dim as
+    [B, H, Tq, LANES] (metadata-only) to shard batch and heads, and
+    re-flattens per shard."""
+    B, H, Tq, _D = q.shape
+    spmd = _spmd_attention_axes(B, H)
+    if spmd is None:
+        return _flash_backward(q, k, v, out, lse_k, g, None, seq_lens,
+                               None, seed, causal, scale, rate, block_q,
+                               block_k, interpret, dq_blocks=dq_blocks,
+                               dkv_blocks=dkv_blocks)
+    mesh, batch_axes, head_axis = spmd
+    from jax.sharding import PartitionSpec as P
+
+    bspec = _batch_spec_entry(batch_axes)
+    qspec = P(bspec, head_axis, None, None)
+    lse4 = lse_k.reshape(B, H, Tq, -1)
+    seed_in = jnp.asarray(seed, jnp.int32)
+
+    def body(q_, k_, v_, out_, lse4_, g_, seed_, lens_):
+        if rate > 0.0:
+            seed_ = _shard_seed(seed_, mesh, batch_axes, head_axis)
+        Bl, Hl = q_.shape[0], q_.shape[1]
+        return _flash_backward(
+            q_, k_, v_, out_, lse4_.reshape(Bl * Hl, Tq, -1), g_, None,
+            lens_, None, seed_, causal, scale, rate, block_q, block_k,
+            interpret, dq_blocks=dq_blocks, dkv_blocks=dkv_blocks)
+
+    out_specs = (qspec, qspec, qspec)
+    if seq_lens is not None:
+        fn = _shard_map(
+            body, mesh=mesh,
+            in_specs=(qspec, qspec, qspec, qspec, qspec, qspec, P(),
+                      P(bspec)),
+            out_specs=out_specs)
+        return fn(q, k, v, out, lse4, g, seed_in, seq_lens)
+    fn = _shard_map(
+        lambda q_, k_, v_, o_, l_, g_, s_: body(q_, k_, v_, o_, l_, g_,
+                                                s_, None),
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, qspec, qspec, qspec, P()),
+        out_specs=out_specs)
+    return fn(q, k, v, out, lse4, g, seed_in)
 
 
 def fused_attention(q, k, v, causal=False, scale=None, seq_lens=None,

@@ -391,9 +391,9 @@ def fused_attention_grad_op(ctx, ins, attrs):
     the Lse output) differentiates the same forward dispatch inline,
     which is exactly what the generic vjp route did."""
     from paddle_tpu.kernels.flash_attention import (_LSE_LANES,
-                                                    _flash_backward,
                                                     _on_tpu,
                                                     dispatch_attention_lse,
+                                                    flash_backward_spmd,
                                                     flash_dispatch_ok,
                                                     pick_block,
                                                     pick_bwd_blocks)
@@ -429,8 +429,11 @@ def fused_attention_grad_op(ctx, ins, attrs):
             (B * H, Tq, _LSE_LANES))
         dq_blocks, dkv_blocks = pick_bwd_blocks(
             Tq, Tk, q.dtype, (min(bq, Tq), min(bk, Tk)))
-        dq, dk, dv = _flash_backward(
-            q, k, v, out.astype(q.dtype), lse_k, g, None, lens, None,
+        # spmd-aware entry: under a mesh-targeted trace the backward
+        # kernels run shard_mapped over the same dp/tp decomposition the
+        # forward dispatch used; single-device traces call straight in
+        dq, dk, dv = flash_backward_spmd(
+            q, k, v, out.astype(q.dtype), lse_k, g, lens,
             seed, causal, scale_, rate, min(bq, Tq), min(bk, Tk),
             not _on_tpu(), dq_blocks=dq_blocks, dkv_blocks=dkv_blocks)
         return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}

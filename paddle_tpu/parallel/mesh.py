@@ -2,6 +2,9 @@
 places vector in parallel_executor.cc:205-217 and NCCLContextMap
 nccl_helper.h:86 — on TPU the mesh IS the communicator)."""
 
+import contextlib
+import threading
+
 import numpy as np
 
 import jax
@@ -26,6 +29,90 @@ def make_mesh(axes, devices=None):
                                                    len(devices)))
     dev_array = np.array(devices[:n_needed]).reshape(sizes)
     return Mesh(dev_array, axis_names=tuple(names))
+
+
+def parse_mesh_spec(spec):
+    """``"dp=4,tp=2" -> {"dp": 4, "tp": 2}`` (the PADDLE_TPU_MESH
+    grammar; also the lint_program --mesh grammar). ``"dp=-1"`` means
+    "all remaining devices" and may appear on at most one axis."""
+    axes = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                "bad mesh spec %r: want name=size[,name=size...]" % spec)
+        name, size = part.split("=", 1)
+        axes[name.strip()] = int(size)
+    if not axes:
+        raise ValueError("empty mesh spec %r" % spec)
+    wild = [n for n, s in axes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("mesh spec %r has more than one -1 axis" % spec)
+    if wild:
+        fixed = int(np.prod([s for s in axes.values() if s != -1]))
+        n_dev = len(jax.devices())
+        if n_dev % fixed:
+            raise ValueError(
+                "mesh spec %r: %d devices not divisible by fixed axes %d"
+                % (spec, n_dev, fixed))
+        axes[wild[0]] = n_dev // fixed
+    return axes
+
+
+def mesh_from_flag():
+    """The mesh declared by ``PADDLE_TPU_MESH`` (e.g. ``dp=4,tp=2`` or
+    ``dp=-1`` for "all devices data-parallel"), or None when the flag is
+    unset — the zero-code-change entry to the mesh-sharded executor
+    path."""
+    from paddle_tpu import flags
+
+    spec = flags.get_flag("mesh")
+    if not spec:
+        return None
+    return make_mesh(parse_mesh_spec(spec))
+
+
+def mesh_signature(mesh):
+    """Hashable identity of a mesh for compile-cache keying: axis names
+    with sizes plus the flat device ids (two same-shape meshes over
+    different device subsets must not alias an executable)."""
+    if mesh is None:
+        return None
+    return (tuple((str(n), int(s)) for n, s in mesh.shape.items()),
+            tuple(int(getattr(d, "id", i))
+                  for i, d in enumerate(mesh.devices.flat)))
+
+
+# --- SPMD lowering context -------------------------------------------------
+# Set by the engine around block tracing when a compile targets a mesh, so
+# mesh-aware lowerings (the shard_map-wrapped flash-attention dispatch) can
+# see which axes exist WITHOUT threading a mesh argument through every
+# op-lowering signature. Thread-local: concurrent compiles (async_executor
+# worker threads) each see their own context.
+_spmd_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def spmd_lowering(mesh, data_axes=("dp",)):
+    """Declare the (mesh, data_axes) a block is being traced under.
+    No-op when ``mesh`` is None."""
+    if mesh is None:
+        yield
+        return
+    prev = getattr(_spmd_ctx, "value", None)
+    _spmd_ctx.value = (mesh, tuple(data_axes))
+    try:
+        yield
+    finally:
+        _spmd_ctx.value = prev
+
+
+def current_spmd():
+    """The active (mesh, data_axes) set by ``spmd_lowering``, or None
+    outside any mesh-targeted trace."""
+    return getattr(_spmd_ctx, "value", None)
 
 
 def set_default_mesh(mesh):

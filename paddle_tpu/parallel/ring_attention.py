@@ -179,10 +179,18 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
             scale=scale)
 
     spec = P(batch_axis, None, axis_name, None)
-    fn = shard_map(
-        body, mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
+    try:
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    except TypeError:  # pre-rename jax spells it check_rep
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_rep=False,
+        )
     return fn(q, k, v)

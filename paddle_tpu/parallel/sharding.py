@@ -9,22 +9,35 @@ collective (all-reduce for replicated, reduce-scatter for sharded) from the
 layout — the scaling-book recipe."""
 
 import re
+import warnings
 
 from jax.sharding import NamedSharding, PartitionSpec
 
 
 class ShardingRules:
-    """Ordered (regex, PartitionSpec) rules; first match wins.
+    """Ordered (regex, PartitionSpec) rules; **first match wins**.
+
+    Rules are tried strictly in insertion order and the FIRST pattern
+    whose ``re.search`` hits decides the spec — later rules never see
+    the name, even if they would match more specifically. Order
+    overlapping rules narrow-to-broad::
 
     >>> rules = ShardingRules([
-    ...     (r".*fc_0\\.w.*", PartitionSpec(None, "tp")),   # column-parallel
-    ...     (r".*fc_1\\.w.*", PartitionSpec("tp", None)),   # row-parallel
+    ...     (r"layer_0\\.fc\\.w", PartitionSpec("tp", None)),  # row-parallel
+    ...     (r".*fc.*\\.w.*", PartitionSpec(None, "tp")),      # column-parallel
     ... ])
-    Unmatched state is replicated.
+
+    With the order flipped, the broad ``.*fc.*`` rule would shadow the
+    layer-0 exception (see ``tests/test_mesh_sharding.py``).
+
+    Unmatched state is replicated; pass ``warn_unmatched=True`` (the
+    engine does, for trainable parameters) to make that silent
+    replication an observability event instead of a surprise.
     """
 
     def __init__(self, rules=()):
         self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self._warned = set()
 
     def add(self, pattern, spec):
         self._rules.append((re.compile(pattern), spec))
@@ -35,7 +48,15 @@ class ShardingRules:
         the analysis sharding-consistency pass audits."""
         return list(self._rules)
 
-    def spec_for(self, name, ndim=None):
+    def signature(self):
+        """Hashable identity of the rule table (pattern, spec-entries)
+        in order — the compile-cache key component; two tables with the
+        same patterns and specs alias the same executable."""
+        return tuple(
+            (pat.pattern, tuple(str(e) for e in spec))
+            for pat, spec in self._rules)
+
+    def spec_for(self, name, ndim=None, warn_unmatched=False):
         for pat, spec in self._rules:
             if pat.search(name):
                 if ndim is not None and len(spec) > ndim:
@@ -43,6 +64,16 @@ class ShardingRules:
                         "sharding rule %r has rank %d > var %r rank %d"
                         % (pat.pattern, len(spec), name, ndim))
                 return spec
+        if warn_unmatched and self._rules and name not in self._warned:
+            self._warned.add(name)
+            from paddle_tpu import observability as obs
+
+            obs.inc("sharding.unmatched_param")
+            obs.event("sharding.unmatched_param", param=name)
+            warnings.warn(
+                "sharding: trainable param %r matches no rule and will "
+                "be replicated on every device" % name, RuntimeWarning,
+                stacklevel=2)
         return PartitionSpec()
 
     def sharding_for(self, mesh, name, value=None):

@@ -10,18 +10,50 @@ from paddle_tpu.platform import default_accelerator_place
 
 
 class ParallelExecutor:
+    """``dist_strategy`` selects the transport (default: the
+    ``PADDLE_TPU_DIST_STRATEGY`` flag, else plain data parallelism):
+
+    * ``""`` / ``"dp"`` — SPMD data parallelism over all local devices
+      (a 1-axis dp mesh, parameters replicated).
+    * ``"mesh"`` — GSPMD over an explicit ``mesh`` (or the
+      ``PADDLE_TPU_MESH`` flag's) with ``shard_rules`` laying out
+      parameters/optimizer state; gradient reduction is an in-graph
+      psum under the dp axis derived by XLA's partitioner — no pserver
+      round-trip (see README "Multi-chip GSPMD").
+    """
+
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None, build_strategy=None,
-                 num_trainers=1, trainer_id=0, scope=None):
+                 num_trainers=1, trainer_id=0, scope=None, dist_strategy=None,
+                 mesh=None, shard_rules=None, data_axes=("dp",)):
+        from paddle_tpu import flags
+
         self._program = main_program or default_main_program()
         self._scope = scope or global_scope()
         self._executor = Executor(default_accelerator_place())
-        self._compiled = CompiledProgram(self._program).with_data_parallel(
-            loss_name=loss_name,
-            build_strategy=build_strategy,
-            exec_strategy=exec_strategy,
-            share_vars_from=getattr(share_vars_from, "_compiled", None),
-        )
+        if dist_strategy is None:
+            dist_strategy = flags.get_flag("dist_strategy")
+        if dist_strategy == "mesh":
+            from paddle_tpu.parallel.mesh import (get_default_mesh,
+                                                  mesh_from_flag)
+
+            if mesh is None:
+                mesh = mesh_from_flag() or get_default_mesh()
+            self._compiled = CompiledProgram(self._program).with_spmd(
+                mesh=mesh, shard_rules=shard_rules, data_axes=data_axes,
+                loss_name=loss_name)
+        elif dist_strategy in ("", "dp"):
+            self._compiled = CompiledProgram(self._program).with_data_parallel(
+                loss_name=loss_name,
+                build_strategy=build_strategy,
+                exec_strategy=exec_strategy,
+                share_vars_from=getattr(share_vars_from, "_compiled", None),
+            )
+        else:
+            raise ValueError(
+                "unknown dist_strategy %r; want '', 'dp', or 'mesh' "
+                "(pserver/nccl2 go through DistributeTranspiler)"
+                % dist_strategy)
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict

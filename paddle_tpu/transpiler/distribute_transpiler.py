@@ -10,6 +10,10 @@ listen_and_serv optimizer blocks — :280-952) and collective "nccl2"
   the SAME compiled program over a DCN-spanning mesh; `transpile` wires the
   coordinator env (paddle_tpu.parallel.env.init_distributed plays
   gen_nccl_id) and `get_trainer_program` returns the program unchanged.
+* "mesh" mode (config.mode = "mesh") supersedes both for dense models:
+  the program is returned unchanged and run under a jax mesh
+  (Executor.run(mesh=...) / PADDLE_TPU_MESH) — gradient all-reduce is an
+  in-graph psum XLA derives from the sharding specs, not an RPC.
 * pserver mode is reproduced structurally: params are round-robin assigned
   to pserver endpoints, the pserver program gets one optimizer sub-block
   per owned param (the listen_and_serv body), and the trainer program's
@@ -97,6 +101,28 @@ class DistributeTranspiler:
         self.origin_program = program or default_main_program()
         self.origin_startup = startup_program
         self._dist_tables = {}
+
+        if self.config.mode == "mesh":
+            # GSPMD mode: no program rewriting AND no RPC transport —
+            # gradient reduction is an in-graph psum under the mesh's dp
+            # axis, derived by XLA's partitioner when the unchanged
+            # program is run with a mesh (Executor.run(mesh=...),
+            # ParallelExecutor(dist_strategy="mesh"), or the
+            # PADDLE_TPU_MESH flag). The transpiler only validates that
+            # no pserver-specific feature was requested.
+            self._mode = "mesh"
+            self._endpoints = []
+            block = self.origin_program.desc.global_block()
+            dist_tables = [
+                op.inputs["W"][0] for op in block.ops
+                if op.type == "lookup_table"
+                and op.attrs.get("is_distributed", False)]
+            if dist_tables:
+                raise NotImplementedError(
+                    "distributed lookup tables %s need the pserver "
+                    "transport; mesh mode shards dense state only"
+                    % sorted(set(dist_tables)))
+            return
 
         if isinstance(trainers, str) or self.config.mode == "nccl2":
             # collective mode: endpoints string in `trainers`
@@ -202,9 +228,9 @@ class DistributeTranspiler:
             out.append((ep, start, end))
         return out
 
-    # -- collective --------------------------------------------------------
+    # -- collective / mesh -------------------------------------------------
     def get_trainer_program(self, wait_port=True):
-        if self._mode == "collective":
+        if self._mode in ("collective", "mesh"):
             return self.origin_program
         return self._build_trainer_program()
 

@@ -53,6 +53,10 @@ def pytest_configure(config):
         "markers",
         "slow: long-running variant excluded from the tier-1 run "
         "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "multichip: needs the 8-virtual-device CPU mesh (the conftest "
+        "provisions it; auto-skips where it could not)")
     if config.getoption("--verify-programs"):
         os.environ["PADDLE_TPU_VERIFY"] = "1"
         # The engine verifies the desc it actually compiles — the
