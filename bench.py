@@ -10,7 +10,7 @@ Primary metric: ResNet-50 train images/sec on whatever device JAX selects
 samples/sec, Transformer-NMT samples/sec, DeepFM examples/sec, the flash
 microbench, and a diagnostic MNIST number) ride along as additional keys —
 all five BASELINE.md configs appear. Select with
-PADDLE_TPU_BENCH=resnet50|bert|transformer|deepfm|flash|mnist|multichip|all
+PADDLE_TPU_BENCH=resnet50|bert|transformer|deepfm|flash|mnist|memory|multichip|all
 (default: everything except multichip — the multi-device GSPMD scaling
 sweep, see bench_multichip).
 """
@@ -418,7 +418,11 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=30,
         path: (chained_grad_loop(g, n_lo), n_lo,
                chained_grad_loop(g, n_hi[path]), n_hi[path])
         for path, g in (("flash", flash_g), ("xla", xla_g))}
-    measured = run_marginal_protocol(variants, (q, k, v), reps)
+    # warmup_rounds=2: BENCH_r05 showed the single untimed interleaved
+    # round still let a 65.5ms straggler land in a timed rep (speedup_min
+    # 0.199 against a 3.4ms median) — the second round absorbs it
+    measured = run_marginal_protocol(variants, (q, k, v), reps,
+                                     warmup_rounds=2)
     (med_flash, t_flash), (med_xla, t_xla) = (measured["flash"],
                                               measured["xla"])
     if med_flash <= 0 or med_xla <= 0:
@@ -428,16 +432,20 @@ def bench_flash_attention(seq=2048, batch=4, heads=16, dim=64, iters=30,
         raise RuntimeError(
             "marginal timing non-positive (flash %.4fs, xla %.4fs): "
             "tunnel overhead swamped the signal" % (med_flash, med_xla))
-    # a rep whose marginal is non-positive OR far below the headline
-    # median caught an overhead swing bigger than its signal; it carries
-    # no kernel information — exclude it from ALL per-rep statistics
-    # (ratios AND error bars), else an epsilon-positive rep publishes an
-    # absurd speedup_max
+    # a rep whose marginal is non-positive, far below, OR far above the
+    # headline median caught an overhead swing bigger than its signal; it
+    # carries no kernel information — exclude it from ALL per-rep
+    # statistics (ratios AND error bars). The low cut stops an
+    # epsilon-positive rep publishing an absurd speedup_max; the
+    # symmetric high cut stops one straggler-contaminated window
+    # publishing an absurd spread/speedup_min (the 65.5ms-vs-3.4ms rep
+    # in BENCH_r05).
     lo_f, lo_x = 0.25 * med_flash, 0.25 * med_xla
-    t_flash_ok = [t for t in t_flash if t > lo_f]
-    t_xla_ok = [t for t in t_xla if t > lo_x]
+    hi_f, hi_x = 4.0 * med_flash, 4.0 * med_xla
+    t_flash_ok = [t for t in t_flash if lo_f < t < hi_f]
+    t_xla_ok = [t for t in t_xla if lo_x < t < hi_x]
     ratios = sorted(x / f for f, x in zip(t_flash, t_xla)
-                    if f > lo_f and x > lo_x)
+                    if lo_f < f < hi_f and lo_x < x < hi_x)
     ms = lambda s: round(float(s) * 1e3, 3)
     out = {
         "flash_attn_bwd_ms_seq2048": ms(med_flash),
@@ -609,6 +617,130 @@ def bench_trace_opt(seq_len=128, batch=2):
     return out
 
 
+def bench_memory_planning(seq_len=2048):
+    """Memory-planning trajectory metrics (PADDLE_TPU_OPT_LEVEL=3,
+    analysis/memory.py):
+
+    * ``bert_seq2048_max_batch`` — the largest batch whose opt-3
+      compiled BERT training step fits the HBM budget
+      (device limit x PADDLE_TPU_HBM_BUDGET_FRAC; a nominal 16 GiB chip
+      when the backend reports no allocator limit, e.g. CPU). Found by
+      doubling + bisection over ``cost_analysis`` compile-peaks — the
+      executable is compiled but never run, so an over-budget candidate
+      cannot OOM the bench.
+    * ``{bert_seq2048,resnet50}_peak_hbm_bytes_opt{2,3}`` — XLA's
+      compile-peak (args + outputs - donated aliases + temps) for the
+      same training step at opt 2 vs opt 3, with the device limit pinned
+      tight (60% of the opt-2 peak and of the planner's own liveness
+      estimate) so the budget forces auto-remat: opt 3 landing below
+      opt 2 is the watermark drop the plan predicts. The
+      ``*_plan_predicted_peak_bytes`` keys carry the planner's own
+      model-space estimate for the opt-3 executable. Caveat for CPU
+      rounds: the XLA CPU backend schedules without memory awareness —
+      a 20-matmul-chain probe shows ``jax.checkpoint`` leaves its
+      compile-peak unchanged (320 -> 352 MiB temp) — so conv-net remat
+      only translates into a *measured* drop on the TPU backend; the
+      attention models (whose win is not storing the [B,H,T,T] score
+      tensors) drop on both."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags, models
+    from paddle_tpu.analysis import memory as memplan
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        kw = dict(d_model=768, n_layers=12, n_heads=12, d_inner=3072)
+        vocab, batch_cap = 30522, 1024
+    else:
+        kw = dict(d_model=128, n_layers=2, n_heads=2, d_inner=256)
+        vocab, batch_cap = 1000, 64
+    frac = float(flags.get_flag("hbm_budget_frac")) or 0.9
+
+    def bert_build(batch):
+        main, startup, h = models.bert.get_model(
+            batch_size=batch, seq_len=seq_len, vocab_size=vocab,
+            dropout=0.1, lr=1e-4, max_position=max(512, seq_len), **kw)
+        feed = models.bert.make_fake_batch(batch, seq_len, vocab,
+                                           kw["n_heads"])
+        return main, startup, h["loss"], feed
+
+    def resnet_build(batch):
+        main, startup, h = models.resnet.get_model(
+            dataset="imagenet", depth=50, class_num=1000, lr=0.1)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.randn(batch, 3, 224, 224).astype(np.float32),
+                "label": rng.randint(0, 1000, (batch, 1)).astype(np.int64)}
+        return main, startup, h["loss"], feed
+
+    def compile_peak(build, batch, opt_level):
+        """(xla_peak_bytes, plan_predicted_peak_bytes) — the latter None
+        below opt 3 (no plan is computed)."""
+        main, startup, loss, feed = build(batch)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            res = exe.cost_analysis(main, feed=feed, fetch_list=[loss],
+                                    opt_level=opt_level)
+        predicted = max((c.memory_plan.predicted_peak_bytes
+                         for c in exe.engine._cache.values()
+                         if c.memory_plan is not None), default=None)
+        mem = res["memory"]
+        if mem is None:
+            return None, predicted
+        arg = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+        outb = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+        tmp = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+        alias = int(getattr(mem, "alias_size_in_bytes", 0) or 0)
+        return arg + max(0, outb - alias) + tmp, predicted
+
+    out = {}
+    budget = memplan.hbm_budget_bytes()
+    if budget is None:
+        budget = int(16 * (1 << 30) * frac)
+    out["memory_hbm_budget_bytes"] = int(budget)
+
+    def fits(b):
+        p, _ = compile_peak(bert_build, b, 3)
+        return p is not None and p <= budget
+
+    lo, b = 0, 1
+    while b <= batch_cap and fits(b):
+        lo, b = b, b * 2
+    if lo and b <= batch_cap:
+        hi = b  # first known-failing batch; bisect the gap
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            if fits(mid):
+                lo = mid
+            else:
+                hi = mid
+    out["bert_seq%d_max_batch" % seq_len] = lo
+
+    for name, build, batch in (
+            ("bert_seq%d" % seq_len, bert_build, 4 if on_tpu else 2),
+            ("resnet50", resnet_build, 512 if on_tpu else 4)):
+        p2, _ = compile_peak(build, batch, 2)
+        if not p2:
+            continue
+        out[name + "_peak_hbm_bytes_opt2"] = int(p2)
+        main, _, loss, feed = build(batch)
+        plan = memplan.plan_memory(
+            main.desc, feed_shapes={k: v.shape for k, v in feed.items()},
+            fetch_names=[loss.name])
+        tight = int(0.6 * min(p2, plan.liveness.peak_bytes) / frac)
+        flags.set_flags({"device_memory_bytes": max(tight, 1)})
+        try:
+            p3, predicted = compile_peak(build, batch, 3)
+        finally:
+            flags.reset_flag("device_memory_bytes")
+        if p3:
+            out[name + "_peak_hbm_bytes_opt3"] = int(p3)
+        if predicted:
+            out[name + "_plan_predicted_peak_bytes"] = int(predicted)
+    return out
+
+
 def main():
     from paddle_tpu import flags, observability
 
@@ -707,6 +839,11 @@ def main():
             result.update(bench_trace_opt())
         except Exception as e:  # noqa: BLE001
             errors["trace"] = str(e)[:200]
+    if which in ("default", "all", "memory"):
+        try:
+            result.update(bench_memory_planning())
+        except Exception as e:  # noqa: BLE001
+            errors["memory"] = str(e)[:200]
     if which in ("default", "all", "mnist") or result["value"] == 0.0:
         v = _try("mnist", bench_mnist_mlp)
         if v:

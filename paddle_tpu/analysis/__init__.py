@@ -39,12 +39,25 @@ from paddle_tpu.analysis.transforms import (  # noqa: F401
     optimize_program,
     transform_passes,
 )
+from paddle_tpu.analysis.memory import (  # noqa: F401
+    DonationPlan,
+    LivenessReport,
+    MemoryPlan,
+    RematPlan,
+    analyze_liveness,
+    plan_donation,
+    plan_memory,
+    plan_remat,
+)
 
 __all__ = [
-    "AnalysisContext", "DEFAULT_PASSES", "DiagnosticReport", "Finding",
-    "Graph", "OpNode", "PASS_REGISTRY", "Pass", "Severity",
+    "AnalysisContext", "DEFAULT_PASSES", "DiagnosticReport",
+    "DonationPlan", "Finding", "Graph", "LivenessReport", "MemoryPlan",
+    "OpNode", "PASS_REGISTRY", "Pass", "RematPlan", "Severity",
     "TRANSFORM_PIPELINE", "TransformContext", "TransformPass",
-    "TransformReport", "VarNode", "VerificationError", "build_graph",
-    "default_passes", "optimize_program", "register_pass", "run_passes",
-    "transform_passes", "verify_graph", "verify_program",
+    "TransformReport", "VarNode", "VerificationError",
+    "analyze_liveness", "build_graph", "default_passes",
+    "optimize_program", "plan_donation", "plan_memory", "plan_remat",
+    "register_pass", "run_passes", "transform_passes", "verify_graph",
+    "verify_program",
 ]

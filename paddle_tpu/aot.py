@@ -36,6 +36,9 @@ def export_aot(dirname, feeded_var_names, fetch_names, program, scope,
     the reference predictor's fixed-shape deployment artifacts.
     """
     import jax
+    import jax.export  # noqa: F401  (submodule; plain `import jax` does
+    # not load it, and bare attribute access trips jax's deprecation
+    # __getattr__ with an AttributeError on the pinned jax)
 
     from paddle_tpu.engine.lowering import BlockProgram, lower_block
 
@@ -99,6 +102,7 @@ class AotPredictor:
 
     def __init__(self, dirname):
         import jax
+        import jax.export  # noqa: F401  (see export_aot)
 
         with open(os.path.join(dirname, _AOT_META)) as f:
             self._meta = json.load(f)

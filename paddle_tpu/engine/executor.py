@@ -45,7 +45,7 @@ def _auto_layout_format():
 
 class CompiledBlock:
     def __init__(self, block_program, jitted, mutated_names, readonly_names,
-                 in_shardings=None):
+                 in_shardings=None, memory_plan=None, remat_segments=0):
         self.block_program = block_program
         self.jitted = jitted
         # executions so far: 0 means the next jitted call pays the XLA
@@ -53,7 +53,10 @@ class CompiledBlock:
         # as "compile", later ones as "run"
         self.run_count = 0
         # state vars both read and re-emitted -> donated to XLA (functional
-        # form of the reference's in-place ParamOut/MomentOut updates)
+        # form of the reference's in-place ParamOut/MomentOut updates).
+        # Under an opt-level-3 memory plan this is the plan's donate
+        # subset; held mutated vars ride in readonly_names (the step
+        # still re-emits them by name — grouping only controls donation).
         self.mutated_names = mutated_names
         # state vars only read (e.g. params in a test program) -> not donated
         self.readonly_names = readonly_names
@@ -61,6 +64,12 @@ class CompiledBlock:
         # multi-host run path needs them to build global jax.Arrays from
         # host values (None when compiled without a mesh)
         self.in_shardings = in_shardings
+        # the analysis.memory plan this executable was compiled under
+        # (opt level 3 only) + the remat segment count actually lowered —
+        # the first run compares plan.predicted_peak_bytes against XLA's
+        # measured memory_analysis peak
+        self.memory_plan = memory_plan
+        self.remat_segments = remat_segments
 
 
 class Engine:
@@ -213,10 +222,25 @@ class Engine:
                 # memory_analysis) — reuses jax's lowering caches for
                 # the executable that just ran, so this is a retrace,
                 # not a second XLA compile.
-                obs.memory.record_compile_memory(
+                measured = obs.memory.record_compile_memory(
                     compiled.jitted,
                     (feed_values, mutated, readonly, rng_seed),
                     label="block%d" % block_idx)
+                if compiled.memory_plan is not None and measured:
+                    # every plan is accountable: predicted (liveness /
+                    # remat cost model) vs measured (XLA's
+                    # memory_analysis of the executable that just ran)
+                    predicted = int(
+                        compiled.memory_plan.predicted_peak_bytes)
+                    obs.set_gauge("hbm.plan_predicted_peak_bytes",
+                                  predicted)
+                    obs.event(
+                        "memory_plan_delta",
+                        predicted_bytes=predicted,
+                        measured_bytes=int(measured),
+                        delta_bytes=int(measured) - predicted,
+                        remat_segments=compiled.remat_segments,
+                        donated=len(compiled.mutated_names))
             # Every step: live-buffer census (scope-resident params vs
             # transient feed/fetch/activation bytes), allocator stats,
             # watermark, and the edge-triggered memory_pressure event.
@@ -292,6 +316,14 @@ class Engine:
                         tuple(data_axes))
         else:
             mesh_key = None
+        # Level-3 plans depend on the HBM budget (device limit × budget
+        # frac), so the budget is part of the key: retuning the budget
+        # never serves a stale plan's executable.
+        mem_budget = None
+        if opt_level >= 3:
+            from paddle_tpu.analysis import memory as memplan
+
+            mem_budget = memplan.hbm_budget_bytes()
         key = (
             program_desc.cached_fingerprint(),
             block_idx,
@@ -306,6 +338,7 @@ class Engine:
             cache_key_extra,
             opt_level,
             mesh_key,
+            mem_budget,
         )
         compiled = self._cache.get(key)
         if compiled is None:
@@ -332,6 +365,44 @@ class Engine:
                     run_desc, _report = optimize_program(
                         program_desc, level=opt_level,
                         feed_names=feed_names, fetch_names=fetch_list)
+                memory_plan, auto_remat = None, 0
+                if opt_level >= 3:
+                    # Memory planning on the POST-transform desc (the
+                    # one that lowers), crash-isolated like every other
+                    # pass: a planner bug degrades to the level-2
+                    # behavior, never takes down the compile.
+                    from paddle_tpu.analysis import memory as memplan
+
+                    try:
+                        with obs.span("memory-plan"), \
+                                obs.time_block("engine.memory_plan_ms"):
+                            memory_plan = memplan.plan_memory(
+                                run_desc,
+                                feed_shapes={
+                                    n: tuple(v.shape) for n, v in
+                                    zip(feed_names, feed_values)},
+                                fetch_names=fetch_list,
+                                budget_bytes=mem_budget)
+                    except Exception:
+                        obs.inc("memory.plan_crashes")
+                        memory_plan = None
+                    if (memory_plan is not None and not remat_segments
+                            and accumulate_steps <= 1 and mesh is None
+                            and not is_test):
+                        # auto-remat only where the manual knob would be
+                        # legal: training step, no accumulation scan, no
+                        # mesh (the shard_map'd step keeps its explicit
+                        # knob)
+                        auto_remat = int(memory_plan.remat.n_segments)
+                    if memory_plan is not None and obs.enabled():
+                        obs.event(
+                            "memory_plan",
+                            predicted_peak_bytes=int(
+                                memory_plan.predicted_peak_bytes),
+                            budget_bytes=mem_budget,
+                            remat_segments=auto_remat,
+                            donated=len(memory_plan.donation.donate),
+                            held=len(memory_plan.donation.held))
                 if verify is None:
                     verify = flags.get_flag("verify")
                 if verify:
@@ -352,14 +423,36 @@ class Engine:
                             shard_rules=shard_rules, data_axes=data_axes,
                             raise_on_error=True)
                 with obs.span("lower"), obs.time_block("engine.lower_ms"):
-                    compiled = self._compile(
-                        run_desc.block(block_idx), feed_names, fetch_list,
-                        is_test, donate_state, mesh=mesh,
-                        feed_values=feed_values, shard_rules=shard_rules,
-                        data_axes=data_axes, amp=amp,
-                        accumulate_steps=accumulate_steps,
-                        remat_segments=remat_segments,
-                    )
+                    try:
+                        compiled = self._compile(
+                            run_desc.block(block_idx), feed_names,
+                            fetch_list, is_test, donate_state, mesh=mesh,
+                            feed_values=feed_values,
+                            shard_rules=shard_rules,
+                            data_axes=data_axes, amp=amp,
+                            accumulate_steps=accumulate_steps,
+                            remat_segments=remat_segments or auto_remat,
+                            memory_plan=memory_plan,
+                        )
+                    except NotImplementedError:
+                        # the remat lowering statically rejects some
+                        # program shapes (intermediate-grad fetches,
+                        # non-@GRAD optimizer inputs...) — an
+                        # auto-chosen plan falls back to donation-only;
+                        # a user-set knob still raises
+                        if not auto_remat:
+                            raise
+                        obs.inc("memory.autoremat_fallback")
+                        compiled = self._compile(
+                            run_desc.block(block_idx), feed_names,
+                            fetch_list, is_test, donate_state, mesh=mesh,
+                            feed_values=feed_values,
+                            shard_rules=shard_rules,
+                            data_axes=data_axes, amp=amp,
+                            accumulate_steps=accumulate_steps,
+                            remat_segments=remat_segments,
+                            memory_plan=memory_plan,
+                        )
             self._cache[key] = compiled
             while len(self._cache) > self._cache_capacity:
                 self._cache.popitem(last=False)
@@ -384,7 +477,7 @@ class Engine:
     def _compile(self, block, feed_names, fetch_list, is_test, donate_state,
                  mesh=None, feed_values=None, shard_rules=None,
                  data_axes=("dp",), amp=False, accumulate_steps=1,
-                 remat_segments=0):
+                 remat_segments=0, memory_plan=None):
         if accumulate_steps > 1 and remat_segments:
             raise NotImplementedError(
                 "accumulate_steps and remat_segments cannot combine yet; "
@@ -420,6 +513,17 @@ class Engine:
         out_set = set(bp.state_out_names)
         mutated = [n for n in bp.state_in_names if n in out_set]
         readonly = [n for n in bp.state_in_names if n not in out_set]
+        if memory_plan is not None and memory_plan.donation is not None:
+            # The donation plan's safety filter (analysis/memory.py
+            # plan_donation): mutated vars it held — fetched names,
+            # non-tensor kinds, sub-block reads — move to the undonated
+            # group. The step still re-emits them by name; only the
+            # donate_argnums grouping changes.
+            allow = memory_plan.donation.donate
+            held = [n for n in mutated if n not in allow]
+            if held:
+                mutated = [n for n in mutated if n in allow]
+                readonly = readonly + held
         mutated_idx = {n: i for i, n in enumerate(mutated)}
         readonly_idx = {n: i for i, n in enumerate(readonly)}
 
@@ -519,7 +623,8 @@ class Engine:
         in_sh = (tuple(jit_kwargs["in_shardings"][:3])
                  if "in_shardings" in jit_kwargs else None)
         return CompiledBlock(bp, jitted, mutated, readonly,
-                             in_shardings=in_sh)
+                             in_shardings=in_sh, memory_plan=memory_plan,
+                             remat_segments=remat_segments)
 
 
 def _poison_nan(val):

@@ -62,7 +62,8 @@ class Executor:
         self.engine._cache.clear()
 
     def cost_analysis(self, program=None, feed=None, fetch_list=None,
-                      scope=None, accumulate_steps=1, remat_segments=0):
+                      scope=None, accumulate_steps=1, remat_segments=0,
+                      opt_level=None):
         """XLA's cost and memory analysis of the compiled step — the
         roofline workflow as a first-class API (round 5 used it to pin
         ResNet-50 at 145.5 GB/step against 670 GB/s achieved; see
@@ -105,7 +106,7 @@ class Executor:
             program.desc, 0, feed_names, feed_values, fetch_names,
             getattr(program, "_is_test", False), True,
             getattr(program, "_amp", False), accumulate_steps,
-            remat_segments=remat_segments)
+            remat_segments=remat_segments, opt_level=opt_level)
         mutated = [self.engine._state_value(scope, n)
                    for n in compiled.mutated_names]
         readonly = [self.engine._state_value(scope, n)

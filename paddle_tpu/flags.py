@@ -36,8 +36,21 @@ DEFS = {
         "the engine's cache-miss seam (analysis/transforms.py "
         "optimize_program): 0 = off, 1 = attention-pattern rewrite to "
         "the fused flash-attention op, 2 = + elementwise+activation "
-        "fusion, constant folding, and CSE. Rewrites operate on a clone; "
+        "fusion, constant folding, and CSE, 3 = + memory planning "
+        "(analysis/memory.py): liveness-driven state donation and "
+        "automatic rematerialization under the HBM budget "
+        "(PADDLE_TPU_HBM_BUDGET_FRAC). Rewrites operate on a clone; "
         "the program desc is never mutated."),
+    "hbm_budget_frac": (
+        float, 0.9,
+        "Fraction of device memory (observability.memory."
+        "device_memory_limit — allocator bytes_limit, overridable via "
+        "PADDLE_TPU_DEVICE_MEMORY_BYTES) the opt-level-3 memory planner "
+        "budgets a step against: when the liveness peak estimate "
+        "exceeds budget, automatic rematerialization picks the "
+        "smallest jax.checkpoint segment count that fits. <=0 or an "
+        "unknowable device limit disables auto-remat (donation "
+        "planning still runs)."),
     "executable_cache_size": (
         int, 128,
         "LRU capacity of the engine's compiled-executable cache "

@@ -15,13 +15,18 @@ All six checkers run (use-before-def, shape-dtype, waw-hazard,
 grad-pairing, dead-op, sharding). ``--opt-level N`` first runs the
 transform pipeline (analysis/transforms.py) over each program and lints
 the *transformed* desc — the same desc the engine would compile at that
-level. Exit code 1 iff any ERROR finding.
+level. ``--memory`` additionally prints each main program's memory plan
+(analysis/memory.py): liveness peak + top-10 contributors, the
+donate/held split, and the remat segment choice under ``--budget-mb``
+(default: the device-derived HBM budget, usually absent on CPU — remat
+reads "off"). Exit code 1 iff any ERROR finding.
 
   python tools/lint_program.py
   python tools/lint_program.py --list-passes
   python tools/lint_program.py --model fit_a_line --model word2vec -v
   python tools/lint_program.py --mesh dp=4,tp=2 --rule '.*fc.*w:,tp'
   python tools/lint_program.py --program /tmp/main.prog --opt-level 2
+  python tools/lint_program.py --model mnist_mlp --memory --budget-mb 4
 """
 
 import argparse
@@ -127,6 +132,23 @@ def _maybe_optimize(program, args, feed_names=None, fetch_names=None):
     return desc
 
 
+def _print_memory_plan(program_or_desc, args, fetch_names=None):
+    """The --memory report: liveness peak + top contributors, donation
+    split, and the remat choice under the requested budget, straight off
+    MemoryPlan.render() — the same planner the engine runs at opt 3."""
+    from paddle_tpu.analysis import memory as memplan
+
+    if args.budget_mb is not None:
+        budget = int(args.budget_mb * (1 << 20))
+    else:
+        budget = memplan.hbm_budget_bytes()
+    plan = memplan.plan_memory(program_or_desc, fetch_names=fetch_names,
+                               budget_bytes=budget)
+    print("-- memory plan (budget: %s) --"
+          % ("%d MiB" % (budget >> 20) if budget else "none"))
+    print(plan.render())
+
+
 def _lint_built_model(name, builder, args):
     from paddle_tpu import unique_name
     from paddle_tpu.analysis import Severity, verify_program
@@ -153,6 +175,8 @@ def _lint_built_model(name, builder, args):
             mesh=mesh, shard_rules=rules)
         startup_report = verify_program(startup)
         report.extend(startup_report.findings)
+        if args.memory:
+            _print_memory_plan(main_desc, args, fetch_names=fetches)
     finally:
         unique_name.switch(old_gen)
 
@@ -183,6 +207,8 @@ def _lint_file(path, args):
     program = _maybe_optimize(program, args)
     report = verify_program(program, mesh=_parse_mesh(args.mesh),
                             shard_rules=_parse_rules(args.rule))
+    if args.memory:
+        _print_memory_plan(program, args)
     min_sev = Severity.INFO if args.verbose else Severity.WARNING
     print(report.render(min_severity=min_sev))
     return report
@@ -210,6 +236,16 @@ def main(argv=None):
                         help="run the transform pipeline at level N and "
                              "lint the transformed desc (0 off, 1 "
                              "fuse-attention, 2 + fusion/folding/cse)")
+    parser.add_argument("--memory", action="store_true",
+                        help="print each main program's memory plan "
+                             "(liveness peak + top contributors, "
+                             "donation split, remat choice) after "
+                             "linting it")
+    parser.add_argument("--budget-mb", type=float, default=None,
+                        metavar="MB",
+                        help="HBM budget for the --memory remat policy "
+                             "(default: device limit x "
+                             "PADDLE_TPU_HBM_BUDGET_FRAC, if knowable)")
     parser.add_argument("--list-passes", action="store_true",
                         help="list every registered pass (name, kind, "
                              "default on/off) and exit")

@@ -22,13 +22,17 @@ instead of quietly taxing every engine step.
 """
 
 
-def run_marginal_protocol(variants, args, reps):
+def run_marginal_protocol(variants, args, reps, warmup_rounds=1):
     """The shared two-loop-count timing driver.
 
     ``variants``: {key: (fn_lo, n_lo, fn_hi, n_hi)} — jitted chained
     loops for the same computation at two loop counts. Every window is
     compiled+warmed once, then all windows are timed INTERLEAVED for
     ``reps`` rounds (so overhead drift hits every variant equally).
+    ``warmup_rounds`` untimed interleaved rounds run before timing; one
+    is usually enough, but a session whose allocator/tunnel state is
+    still settling after the first interleaved dispatch needs a second
+    (BENCH_r05 still showed a 65.5 ms first-rep spread with one).
 
     Returns {key: (marginal_seconds, per_rep_marginals)} where the
     headline marginal is diff-of-medians — median wall per loop count,
@@ -53,15 +57,16 @@ def run_marginal_protocol(variants, args, reps):
             jax.device_get(fn_lo(*args))    # compile + warm
             jax.device_get(fn_hi(*args))
         wall[key] = ([], [])
-    # One untimed interleaved round before timing starts: the first
+    # Untimed interleaved rounds before timing starts: the first
     # *interleaved* dispatch after the compile loop still eats stragglers
     # (host-side caching, allocator growth), which otherwise lands in
     # rep 0 of whichever variant runs first — observed as a 65.5ms
     # flash_attn_bwd_ms spread against a 3.4ms median.
-    for key, (fn_lo, _, fn_hi, _) in variants.items():
-        with obs.span("marginal:warmup", variant=key):
-            jax.device_get(fn_lo(*args))
-            jax.device_get(fn_hi(*args))
+    for wr in range(warmup_rounds):
+        for key, (fn_lo, _, fn_hi, _) in variants.items():
+            with obs.span("marginal:warmup", variant=key, round=wr):
+                jax.device_get(fn_lo(*args))
+                jax.device_get(fn_hi(*args))
     for rep in range(reps):
         for key, (fn_lo, _, fn_hi, _) in variants.items():
             for which, fn in ((0, fn_lo), (1, fn_hi)):
