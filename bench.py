@@ -10,9 +10,10 @@ Primary metric: ResNet-50 train images/sec on whatever device JAX selects
 samples/sec, Transformer-NMT samples/sec, DeepFM examples/sec, the flash
 microbench, and a diagnostic MNIST number) ride along as additional keys —
 all five BASELINE.md configs appear. Select with
-PADDLE_TPU_BENCH=resnet50|bert|transformer|deepfm|flash|mnist|memory|multichip|all
+PADDLE_TPU_BENCH=resnet50|bert|transformer|deepfm|flash|mnist|memory|multichip|serving|all
 (default: everything except multichip — the multi-device GSPMD scaling
-sweep, see bench_multichip).
+sweep, see bench_multichip — and serving — the INT8 freeze/quantize/
+continuous-batching pipeline, see bench_serving).
 """
 
 import json
@@ -741,6 +742,131 @@ def bench_memory_planning(seq_len=2048):
     return out
 
 
+def bench_serving():
+    """PADDLE_TPU_BENCH=serving block: the inference pipeline end to end
+    — freeze, INT8 post-training quantization, continuous-batching
+    server — on whatever backend JAX selects.
+
+    Emits ``resnet50_int8_images_per_sec`` (cifar depth-20 resnet, the
+    CPU-probe stand-in multichip_probe.py also uses) against the fp32
+    frozen rate, plus ``bert_base_served_qps`` / ``bert_base_served_p99_ms``
+    from the server's own SLO histograms under a Poisson load at ~0.8x
+    measured capacity. Honesty note on ``int8_speedup_vs_fp32``: on the
+    CPU backend the int8 path runs the exact fp32 emulation
+    (ops/quant_ops.py — XLA CPU's native s8xs8->s32 dot is 5-50x SLOWER
+    than f32, measured), so the ratio sits near 1.0 there; the 3x+
+    headline lives on hardware with an int8 MXU path where
+    ``int8_native`` resolves to the s32-accumulate kernels."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import (
+        InferenceServer,
+        freeze_program,
+        post_training_quantize,
+    )
+
+    on_tpu = jax.default_backend() != "cpu"
+    rng = np.random.RandomState(0)
+    out = {}
+
+    # -- resnet: fp32 frozen vs int8 request rate -------------------------
+    main_p, startup, h = models.resnet.get_model(
+        dataset="cifar10", depth=20, class_num=10, lr=0.1)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    feed_names, fetch_names = ["img"], [h["logits"].name]
+    frozen, _ = freeze_program(main_p, feed_names, fetch_names, scope=scope)
+    batch = 256 if on_tpu else 32
+
+    def mk(n):
+        return {"img": rng.randn(n, 3, 32, 32).astype(np.float32)}
+
+    int8_prog, _, qrep = post_training_quantize(
+        frozen, [mk(batch) for _ in range(4)], feed_names, fetch_names,
+        scope=scope, executor=exe, max_batches=4)
+    out["serving_quantized_ops"] = len(qrep.quantized)
+
+    def rate(prog, steps=15, warmup=3):
+        feed = mk(batch)
+        with fluid.scope_guard(scope):
+            run = lambda: exe.run(prog, feed=feed, fetch_list=fetch_names,
+                                  return_numpy=False)[0]
+            ips, _ = _throughput(run, batch, steps, warmup)
+        return ips
+
+    fp32_ips = rate(frozen)
+    int8_ips = rate(int8_prog)
+    out["resnet50_fp32_frozen_images_per_sec"] = round(fp32_ips, 2)
+    out["resnet50_int8_images_per_sec"] = round(int8_ips, 2)
+    out["int8_speedup_vs_fp32"] = round(int8_ips / fp32_ips, 3)
+
+    # -- bert: served QPS + p99 under Poisson load ------------------------
+    if on_tpu:
+        kw = dict(d_model=768, n_layers=12, n_heads=12, d_inner=3072)
+        seq_len, vocab = 128, 30522
+    else:
+        kw = dict(d_model=128, n_layers=2, n_heads=2, d_inner=256)
+        seq_len, vocab = 32, 512
+    bmain, bstartup, bh = models.bert.get_model(
+        batch_size=4, seq_len=seq_len, vocab_size=vocab, dropout=0.0,
+        lr=1e-4, max_position=512, **kw)
+    bexe = fluid.Executor()
+    bscope = fluid.Scope()
+    with fluid.scope_guard(bscope):
+        bexe.run(bstartup)
+    enc_feeds = ["src_ids", "pos_ids", "sent_ids", "seq_lens"]
+    bfetch = [bh["enc_out"].name]
+    bfrozen, _ = freeze_program(bmain, enc_feeds, bfetch, scope=bscope)
+
+    def bert_feed(n):
+        b = models.bert.make_fake_batch(n, seq_len, vocab, kw["n_heads"],
+                                        rng=rng)
+        return {k: b[k] for k in enc_feeds}
+
+    bint8, _, _ = post_training_quantize(
+        bfrozen, [bert_feed(4) for _ in range(4)], enc_feeds, bfetch,
+        scope=bscope, executor=bexe, max_batches=4)
+
+    buckets = (1, 2, 4, 8)
+    server = InferenceServer(bint8, enc_feeds, bfetch, scope=bscope,
+                             executor=bexe, buckets=buckets,
+                             max_wait_ms=5.0, name="bench")
+    with server:
+        server.warmup(bert_feed(1))
+        # capacity from the top bucket: rows/sec of the padded executable
+        t0 = time.perf_counter()
+        cap_runs = 6
+        for _ in range(cap_runs):
+            server.run(bert_feed(buckets[-1]))
+        capacity_qps = cap_runs * buckets[-1] / (time.perf_counter() - t0)
+        target_qps = max(1.0, 0.8 * capacity_qps)
+        duration = 4.0
+        futures = []
+        t0 = time.perf_counter()
+        next_t = t0
+        while True:
+            next_t += rng.exponential(1.0 / target_qps)
+            if next_t >= t0 + duration:
+                break
+            delay = next_t - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futures.append(server.submit(bert_feed(1)))
+        for f in futures:
+            f.result(timeout=600)
+        elapsed = time.perf_counter() - t0
+    req_h = obs.snapshot()["histograms"].get("serving.request_ms") or {}
+    out["bert_base_served_qps"] = round(len(futures) / elapsed, 2)
+    if req_h.get("p99") is not None:
+        out["bert_base_served_p99_ms"] = round(req_h["p99"], 2)
+    return out
+
+
 def main():
     from paddle_tpu import flags, observability
 
@@ -834,6 +960,21 @@ def main():
                     result["value"] = result[key]
         except Exception as e:  # noqa: BLE001
             errors["multichip"] = str(e)[:200]
+    serving_metrics = {}
+    if which in ("all", "serving"):
+        # not in "default": the Poisson load level runs ~10s of wall
+        # clock; PADDLE_TPU_BENCH=serving is the INT8-serving selector
+        try:
+            serving_metrics = bench_serving()
+            result.update(serving_metrics)
+            if result["value"] == 0.0 and \
+                    "resnet50_int8_images_per_sec" in serving_metrics:
+                result["metric"] = "resnet50_int8_images_per_sec"
+                result["unit"] = "images/sec"
+                result["value"] = serving_metrics[
+                    "resnet50_int8_images_per_sec"]
+        except Exception as e:  # noqa: BLE001
+            errors["serving"] = str(e)[:200]
     if which in ("default", "all", "trace"):
         try:
             result.update(bench_trace_opt())
@@ -886,6 +1027,10 @@ def main():
                      for k, v in sorted(c.items())
                      if k.startswith("recovery.")},
     }
+    if serving_metrics:
+        # the serving SLO numbers ride in counters too, so BENCH_*.json
+        # trend tooling that only diffs the counters object sees them
+        result["counters"]["serving"] = serving_metrics
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

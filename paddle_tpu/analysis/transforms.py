@@ -64,12 +64,16 @@ TRANSFORM_PIPELINE = (
 
 class TransformContext:
     """Run-site facts a rewrite may use: the feed/fetch lists the compiled
-    executable will run with, and the requested opt level."""
+    executable will run with, the requested opt level, and (for passes
+    that bake weights, e.g. the freeze pipeline's BN fold) the scope
+    holding the trained parameter values."""
 
-    def __init__(self, feed_names=None, fetch_names=None, level=1):
+    def __init__(self, feed_names=None, fetch_names=None, level=1,
+                 scope=None):
         self.feed_names = tuple(feed_names or ())
         self.fetch_names = tuple(fetch_names or ())
         self.level = int(level)
+        self.scope = scope
 
 
 class TransformPass(Pass):
@@ -131,7 +135,7 @@ def transform_passes(level):
 
 
 def optimize_program(program_or_desc, level=None, feed_names=None,
-                     fetch_names=None, passes=None):
+                     fetch_names=None, passes=None, scope=None):
     """Run the transform pipeline over a clone of the program.
 
     Returns ``(desc, report)``. ``desc`` is the ORIGINAL desc object
@@ -154,7 +158,7 @@ def optimize_program(program_or_desc, level=None, feed_names=None,
     from paddle_tpu import observability as obs
 
     ctx = TransformContext(feed_names=feed_names, fetch_names=fetch_names,
-                           level=level)
+                           level=level, scope=scope)
     with obs.span("transform", level=level), \
             obs.time_block("transform.pipeline_ms"):
         good = desc.clone()

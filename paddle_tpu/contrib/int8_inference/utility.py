@@ -3,18 +3,18 @@ python/paddle/fluid/contrib/int8_inference/utility.py Calibrator — the
 fork's headline flow: run FP32 inference over a sample set, collect
 activation ranges, emit an INT8 program)."""
 
-import numpy as np
-
 import paddle_tpu.fluid as fluid
-from paddle_tpu.contrib.slim.quantization import (
-    QuantizationTransformPass,
-    QuantizationFreezePass,
-)
 
 
 class Calibrator:
     """Collects abs-max activation statistics by running the float program
-    over calibration batches, then freezes an INT8 inference program."""
+    over calibration batches, then freezes an INT8 inference program.
+
+    Backed by the real PTQ pipeline (inference/quantize.py):
+    calibrate_program collects the ranges through the metrics registry
+    and quantize_desc rewrites conv/fc/matmul in place — the whole
+    program is kept (no fetch-cone pruning), so callers can still fetch
+    training-side metrics like accuracy from the INT8 program."""
 
     def __init__(self, *args, **kwargs):
         # reference signature is (*args, **kwargs) (utility.py Calibrator)
@@ -30,27 +30,26 @@ class Calibrator:
         self.algo = params.get("algo", "abs_max")
         self._sampled = []
         self._frozen = None
+        self._report = None  # QuantReport from the last freeze
 
     def calibrate_and_freeze(self, batches):
-        """batches: iterable of feed dicts. Returns the INT8 program."""
+        """batches: iterable of feed dicts. Returns the INT8 program
+        (``self.program``, rewritten in place per the reference
+        contract)."""
+        from paddle_tpu.framework import rebind_program_desc
+        from paddle_tpu.inference.quantize import (
+            calibrate_program,
+            quantize_desc,
+        )
+
+        batches = list(batches)
         with fluid.scope_guard(self.scope):
-            # 1. instrument with observers (moving-average abs-max)
-            pass_ = QuantizationTransformPass(scope=self.scope)
-            pass_.apply(self.program)
-            # 2. run calibration batches with observers live (program-level
-            #    is_test off; per-op is_test attrs from the clone still hold
-            #    for dropout/BN, so only the observers change behavior)
-            was_test = getattr(self.program, "_is_test", False)
-            self.program._is_test = False
-            try:
-                for feed in batches:
-                    self.exe.run(self.program, feed=feed,
-                                 fetch_list=self.fetch_list)
-            finally:
-                self.program._is_test = was_test
-            # 3. freeze to int8
-            freeze = QuantizationFreezePass(self.scope)
-            freeze.apply(self.program)
+            stats = calibrate_program(
+                self.program, batches, scope=self.scope, executor=self.exe,
+                max_batches=len(batches) or None)
+            work = self.program.desc.clone()
+            self._report = quantize_desc(work, self.scope, stats.ranges())
+            rebind_program_desc(self.program, work)
         return self.program
 
     def sample_data(self, batches=None):

@@ -117,6 +117,7 @@ class Engine:
         cache_key_extra=None,
         seed=0,
         donate_state=True,
+        state_writeback=True,
         mesh=None,
         shard_rules=None,
         data_axes=("dp",),
@@ -253,8 +254,17 @@ class Engine:
             _check_finite(zip(fetch_list, fetches),
                           step=self._run_counter, kind="fetch")
 
-        for name, val in zip(compiled.block_program.state_out_names, state_out):
-            scope.set(name, val)
+        if state_writeback:
+            for name, val in zip(compiled.block_program.state_out_names,
+                                 state_out):
+                scope.set(name, val)
+        else:
+            # Inference mode (serving): a frozen test program only
+            # re-emits state values it read unchanged, so skipping the
+            # write-back keeps the scope immutable — submitter threads
+            # may read it concurrently with the worker's run. Pairs with
+            # donate_state=False (no donation bookkeeping for params).
+            obs.inc("engine.infer_runs")
 
         if return_numpy:
             # one batched host transfer for all fetches (device_get on the

@@ -165,6 +165,36 @@ DEFS = {
         "--recovery-dir; training scripts pass it to a "
         "CheckpointManager + resilience.ResilientDriver, which "
         "restores the latest complete step on startup."),
+    "serving_buckets": (
+        str, "1,2,4,8,16,32",
+        "Padded batch-size bucket edges of the continuous-batching "
+        "server (paddle_tpu.inference.serving), comma-separated and "
+        "ascending. Coalesced requests are padded up to the smallest "
+        "edge that fits; each edge compiles exactly one executable "
+        "(LRU-cached in the engine), so more edges = less padding "
+        "waste but more compile cache pressure."),
+    "serving_max_wait_ms": (
+        float, 5.0,
+        "Max time the serving batcher holds the oldest queued request "
+        "while waiting to fill a bigger bucket, in ms. This timer is "
+        "the p99 bound at low QPS: a lone request is dispatched after "
+        "at most this wait. 0 = dispatch immediately (no "
+        "coalescing beyond what is already queued)."),
+    "serving_calibration_batches": (
+        int, 8,
+        "Representative batches the post-training-quantization "
+        "calibrator (paddle_tpu.inference.quantize) runs through the "
+        "frozen fp32 program to collect per-tensor abs-max ranges "
+        "before rewriting conv/fc/matmul ops to int8."),
+    "int8_native": (
+        str, "auto",
+        "Lowering mode of quantized_conv2d/quantized_matmul: '1' = "
+        "native int8 dot_general/conv with int32 accumulation (the "
+        "TPU MXU path), '0' = numerically exact fp32 emulation "
+        "(int8 values cast to f32; products <= 127^2 and per-dot "
+        "partial sums stay inside the f32 mantissa). 'auto' = native "
+        "everywhere except the CPU backend, where XLA's int8 codegen "
+        "is slower than fp32."),
 }
 
 _overrides = {}

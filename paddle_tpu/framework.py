@@ -500,19 +500,7 @@ class Program:
                     "nor the reference framework.proto format (%s) "
                     "accepted the bytes" % (native_err, proto_err)
                 ) from native_err
-        program = Program()
-        program.desc = desc
-        desc._version_token = 1
-        program.blocks = [Block(program, i)
-                          for i in range(desc.num_blocks())]
-        for b in program.blocks:
-            for name, vd in b.desc.vars.items():
-                v = Variable.__new__(Variable)
-                v.block = b
-                v.desc = vd
-                b.vars[name] = v
-        program._bump_version()
-        return program
+        return program_from_desc(desc)
 
     def current_block(self):
         return self.blocks[self.current_block_idx]
@@ -606,6 +594,44 @@ def _flip_is_test(program_desc):
         for op in b.ops:
             if "is_test" in op.attrs or op.type in ("dropout", "batch_norm", "lrn"):
                 op.attrs["is_test"] = True
+
+
+def program_from_desc(desc):
+    """Wrap a ProgramDescData in a fresh Program: Block/Variable wrappers
+    rebuilt over the existing VarDescData objects (the desc is adopted,
+    not copied). One rebuild path shared by parse_from_string, the io
+    loaders, and the freeze/quantize rewrites."""
+    program = Program()
+    program.desc = desc
+    desc._version_token = 1
+    program.blocks = [Block(program, i) for i in range(desc.num_blocks())]
+    for b in program.blocks:
+        for name, vd in b.desc.vars.items():
+            v = Variable.__new__(Variable)
+            v.block = b
+            v.desc = vd
+            b.vars[name] = v
+    program._bump_version()
+    return program
+
+
+def rebind_program_desc(program, desc):
+    """Point an existing Program at a rewritten desc in place (the
+    contrib Calibrator's save_int8_model contract mutates its program
+    rather than returning a new one). Wrappers are rebuilt; callers'
+    Variable handles into the OLD desc become stale."""
+    program.desc = desc
+    desc._version_token = getattr(program, "_version", 0)
+    program.blocks = [Block(program, i) for i in range(desc.num_blocks())]
+    for b in program.blocks:
+        for name, vd in b.desc.vars.items():
+            v = Variable.__new__(Variable)
+            v.block = b
+            v.desc = vd
+            b.vars[name] = v
+    program.current_block_idx = 0
+    program._bump_version()
+    return program
 
 
 # -- default program singletons (reference: framework.py:2597-2665) --------

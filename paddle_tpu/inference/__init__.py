@@ -1,0 +1,56 @@
+"""paddle_tpu.inference — the serving side of the system.
+
+Three stages over infrastructure the training path already built
+(reference: the fork's MKL-DNN INT8 serving path, PAPER.md §2.8; the
+train-graph/serve-graph split of arXiv:1605.08695):
+
+* **freeze** (freeze.py): trained ProgramDesc -> verified
+  inference-only desc on the analysis.transforms registry — training
+  ops stripped by role, pruned to the fetch cone, batch-norm folded
+  into the preceding conv/fc weights.
+* **quantize** (quantize.py): post-training INT8 — calibrate per-tensor
+  ranges over representative batches, then rewrite conv/fc/matmul to
+  ``quantize -> int8 dot (int32 accumulate) -> dequantize`` with
+  per-channel weight scales (ops/quant_ops.py).
+* **serve** (serving.py): a continuous-batching request queue in front
+  of the compiled frozen executable — padded shape buckets, one
+  LRU-cached executable per bucket, a max-wait timer bounding p99, SLO
+  histograms in the metrics registry.
+
+The classic predictor API (AnalysisConfig / create_paddle_predictor)
+lives in predictor.py and re-exports here unchanged.
+"""
+
+from paddle_tpu.inference.freeze import (  # noqa: F401
+    FoldBatchNormPass,
+    FreezeReport,
+    StripTrainingPass,
+    freeze_program,
+)
+from paddle_tpu.inference.predictor import (  # noqa: F401
+    AnalysisConfig,
+    AnalysisPredictor,
+    PaddleTensor,
+    create_paddle_predictor,
+)
+from paddle_tpu.inference.quantize import (  # noqa: F401
+    QUANTIZABLE_OPS,
+    CalibrationStats,
+    QuantReport,
+    calibrate_program,
+    post_training_quantize,
+    quantize_program,
+)
+from paddle_tpu.inference.serving import (  # noqa: F401
+    InferenceServer,
+    parse_buckets,
+)
+
+__all__ = [
+    "AnalysisConfig", "AnalysisPredictor", "CalibrationStats",
+    "FoldBatchNormPass", "FreezeReport", "InferenceServer",
+    "PaddleTensor", "QUANTIZABLE_OPS", "QuantReport",
+    "StripTrainingPass", "calibrate_program", "create_paddle_predictor",
+    "freeze_program", "parse_buckets", "post_training_quantize",
+    "quantize_program",
+]

@@ -1,0 +1,226 @@
+"""Inference engine: AnalysisConfig/Predictor facade over AOT-compiled XLA
+(reference: paddle/fluid/inference/api/analysis_predictor.cc —
+CreatePaddlePredictor:734, Run:183, ZeroCopyTensor; analysis passes =
+XLA compilation here, SURVEY.md §3.5)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.executor import Executor
+from paddle_tpu.io import load_inference_model
+from paddle_tpu.platform import CPUPlace, TPUPlace
+
+
+class AnalysisConfig:
+    """(reference: paddle_analysis_config.h). GPU knobs map to the TPU
+    accelerator; the MKLDNN/TensorRT low-precision knobs map to the
+    native INT8 path (inference/quantize.py) — the predictor calibrates
+    on its first live batches and swaps in the quantized program."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+        self._use_accelerator = True
+        self._batch_warmup_shapes = None
+        self._ir_optim = True
+        self._int8 = False
+        self._int8_announced = False
+
+    def disable_gpu(self):
+        self._use_accelerator = False
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self._use_accelerator = True
+
+    def enable_mkldnn(self):
+        """The reference fork's MKL-DNN INT8 serving path: here it opts
+        the predictor into post-training INT8 quantization (calibrate on
+        the first live batches, then rewrite conv/fc/matmul to int8)."""
+        self._request_int8("mkldnn")
+
+    def enable_tensorrt_engine(self, **kwargs):
+        """TensorRT parity knob — same INT8 path as enable_mkldnn (XLA
+        plays the engine role; precision_mode is honored as int8)."""
+        self._request_int8("tensorrt")
+
+    def _request_int8(self, api):
+        from paddle_tpu import observability as obs
+
+        self._int8 = True
+        if not self._int8_announced:
+            # one-time: API-parity knobs should do something visible
+            obs.event("inference.int8_path_enabled", api=api)
+            self._int8_announced = True
+
+    def switch_ir_optim(self, flag=True):
+        """Toggle the transform pipeline for this predictor's compiles —
+        threaded to the engine ``opt_level`` (0 when off)."""
+        self._ir_optim = bool(flag)
+
+
+class PaddleTensor:
+    """Plain container matching the reference's PaddleTensor."""
+
+    def __init__(self, data=None, name=None):
+        self.name = name
+        self.data = np.asarray(data) if data is not None else None
+
+    @property
+    def shape(self):
+        return list(self.data.shape) if self.data is not None else None
+
+
+class AnalysisPredictor:
+    def __init__(self, config):
+        import jax
+
+        from paddle_tpu.aot import AotPredictor, has_aot_artifact
+
+        self.config = config
+        self._aot = None
+        self._calib_feeds = []
+        if has_aot_artifact(config.model_dir):
+            # serialized StableHLO artifact present: execute it directly
+            # — no Program rebuild, no op-registry re-lowering
+            # (reference: analysis_predictor.cc:391's frozen-load path).
+            # The artifact is platform-specialized; if it was exported
+            # for a different backend (or the user disabled the
+            # accelerator), fall back to the native files beside it.
+            aot = AotPredictor(config.model_dir)
+            backend = "cpu" if not config._use_accelerator \
+                else jax.default_backend()
+            if aot.runs_on(backend):
+                self._aot = aot
+                self._feed_names = aot.feed_names
+                self._fetch_names = aot.fetch_names
+                return
+        place = TPUPlace() if config._use_accelerator else CPUPlace()
+        self._exe = Executor(place)
+        self._scope = Scope()
+        with fluid.scope_guard(self._scope):
+            (self._program, self._feed_names,
+             self._fetch_vars) = load_inference_model(
+                config.model_dir, self._exe,
+                params_filename=config.params_file)
+        self._fetch_names = [
+            f.name if hasattr(f, "name") else str(f)
+            for f in self._fetch_vars
+        ]
+
+    @classmethod
+    def from_frozen(cls, dirname=None, program=None, feed_names=None,
+                    fetch_names=None, scope=None, config=None):
+        """Build a predictor from a frozen artifact directory
+        (io.save_frozen_model) or from an in-memory frozen program +
+        feed/fetch lists + scope — no AnalysisConfig/model_dir dance."""
+        from paddle_tpu.io import load_frozen_model
+
+        self = cls.__new__(cls)
+        self.config = config or AnalysisConfig()
+        self._aot = None
+        self._calib_feeds = []
+        self._exe = Executor(
+            TPUPlace() if self.config._use_accelerator else CPUPlace())
+        self._scope = scope if scope is not None else Scope()
+        if dirname is not None:
+            (self._program, self._feed_names, self._fetch_names,
+             _meta) = load_frozen_model(dirname, scope=self._scope)
+        else:
+            if program is None or feed_names is None or fetch_names is None:
+                raise ValueError("from_frozen needs dirname= or all of "
+                                 "program=/feed_names=/fetch_names=")
+            self._program = program
+            self._feed_names = list(feed_names)
+            self._fetch_names = [
+                f.name if hasattr(f, "name") else str(f)
+                for f in fetch_names]
+        return self
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    @property
+    def _opt_level(self):
+        # switch_ir_optim(False) -> force level 0; True -> the engine's
+        # flag default stays in charge (None)
+        return None if self.config._ir_optim else 0
+
+    def run(self, inputs):
+        """inputs: list of PaddleTensor (positional by feed order) or dict
+        name->array. Returns list of PaddleTensor."""
+        if isinstance(inputs, dict):
+            feed = {k: np.asarray(v) for k, v in inputs.items()}
+        else:
+            feed = {}
+            for name, t in zip(self._feed_names, inputs):
+                feed[t.name or name] = t.data
+        if self._aot is not None:
+            outs = self._aot.run(feed)
+        else:
+            if self.config._int8:
+                self._maybe_quantize(feed)
+            with fluid.scope_guard(self._scope):
+                outs = self._exe.run(self._program, feed=feed,
+                                     fetch_list=self._fetch_names,
+                                     opt_level=self._opt_level)
+        return [PaddleTensor(o, n) for o, n in zip(outs, self._fetch_names)]
+
+    def _maybe_quantize(self, feed):
+        """Self-calibrating INT8 (enable_mkldnn/enable_tensorrt_engine):
+        the first ``serving_calibration_batches`` live batches run fp32
+        and double as calibration data; then the program is frozen
+        (BN folded), quantized, and swapped in."""
+        from paddle_tpu import flags
+        from paddle_tpu import observability as obs
+
+        if self._calib_feeds is None:
+            return  # already swapped
+        self._calib_feeds.append(
+            {k: np.asarray(v) for k, v in feed.items()})
+        needed = int(flags.get_flag("serving_calibration_batches"))
+        if len(self._calib_feeds) < needed:
+            return
+        from paddle_tpu.inference.freeze import freeze_program
+        from paddle_tpu.inference.quantize import (
+            calibrate_program,
+            quantize_program,
+        )
+
+        with fluid.scope_guard(self._scope):
+            frozen, _ = freeze_program(
+                self._program, self._feed_names, self._fetch_names,
+                scope=self._scope)
+            stats = calibrate_program(frozen, self._calib_feeds,
+                                      scope=self._scope, executor=self._exe,
+                                      max_batches=needed)
+            int8_prog, report = quantize_program(frozen, stats,
+                                                 scope=self._scope)
+        self._program = int8_prog
+        self._calib_feeds = None
+        obs.event("inference.int8_swapped",
+                  quantized=len(report.quantized),
+                  skipped=len(report.skipped))
+
+    def serve(self, buckets=None, max_wait_ms=None, name="serving"):
+        """Continuous-batching façade: an InferenceServer over this
+        predictor's (possibly quantized) program, scope, and executor.
+        Caller starts it (context manager or .start())."""
+        from paddle_tpu.inference.serving import InferenceServer
+
+        if self._aot is not None:
+            raise NotImplementedError(
+                "serve() needs the native program path; the AOT artifact "
+                "predictor has no desc to batch against")
+        return InferenceServer(
+            self._program, self._feed_names, self._fetch_names,
+            scope=self._scope, executor=self._exe, buckets=buckets,
+            max_wait_ms=max_wait_ms, name=name)
+
+
+def create_paddle_predictor(config):
+    """(reference: analysis_predictor.cc:734 factory)."""
+    return AnalysisPredictor(config)

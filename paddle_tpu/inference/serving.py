@@ -1,0 +1,296 @@
+"""Continuous-batching inference server over a frozen program.
+
+A request queue in front of the compiled frozen executable: submitter
+threads enqueue single requests (each a feed dict with a leading batch
+dim), one worker thread coalesces them along axis 0 into padded shape
+buckets, and each bucket shape compiles exactly one executable — the
+engine's LRU cache keys on the feed signature plus a ``("serving",
+name, bucket)`` tag, so bucket executables never alias a training
+compile. Dispatch happens when the next bucket edge fills OR when the
+oldest queued request has waited ``serving_max_wait_ms`` — the max-wait
+timer is the p99 bound at low QPS (a lone request never waits longer
+than the timer plus one batch's compute).
+
+The worker runs the engine with ``donate_state=False`` (params are
+leased, not consumed — no donation bookkeeping, no deleted-buffer races
+between steps) and ``state_writeback=False`` (a frozen program re-emits
+state it read unchanged; skipping the write keeps the scope immutable
+under concurrent submitters).
+
+SLO telemetry (gated by PADDLE_TPU_METRICS, histograms in the process
+metrics registry): ``serving.request_ms`` (submit -> result),
+``serving.queue_ms`` (submit -> batch start), ``serving.batch_ms``,
+``serving.batch_fill`` (rows/bucket), ``serving.queue_depth``
+(histogram, sampled at each dispatch; also a live gauge), counters
+``serving.requests`` / ``serving.batches`` / ``serving.padded_rows``.
+
+Concurrency note (PAPERS.md arXiv:2011.03641): keeping the device
+saturated comes from coalescing, not from parallel dispatch — a single
+worker feeding padded buckets to one async engine stream is the whole
+model.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+
+def parse_buckets(spec=None):
+    """'1,2,4,8' (or an iterable of ints) -> sorted tuple of edges.
+    Defaults to the ``serving_buckets`` flag."""
+    from paddle_tpu import flags
+
+    if spec is None:
+        spec = flags.get_flag("serving_buckets")
+    if isinstance(spec, str):
+        edges = [int(p) for p in spec.replace(" ", "").split(",") if p]
+    else:
+        edges = [int(p) for p in spec]
+    edges = sorted(set(e for e in edges if e > 0))
+    if not edges:
+        raise ValueError("serving buckets must name at least one edge")
+    return tuple(edges)
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "future", "t_enq")
+
+    def __init__(self, feed, rows):
+        self.feed = feed
+        self.rows = rows
+        self.future = Future()
+        self.t_enq = time.monotonic()
+
+
+class InferenceServer:
+    """Continuous-batching server over one frozen (and typically
+    quantized) program.
+
+    >>> server = InferenceServer(frozen, feed_names, fetch_names,
+    ...                          scope=scope)
+    >>> with server:
+    ...     out = server.run({"img": batch})          # blocking
+    ...     fut = server.submit({"img": batch})       # async Future
+    """
+
+    def __init__(self, program, feed_names, fetch_names, scope=None,
+                 executor=None, buckets=None, max_wait_ms=None,
+                 name="serving"):
+        from paddle_tpu import flags
+        from paddle_tpu.executor import Executor, global_scope
+
+        self.program = program
+        self.feed_names = tuple(feed_names)
+        self.fetch_names = tuple(
+            f.name if hasattr(f, "name") else str(f) for f in fetch_names)
+        self.scope = scope if scope is not None else global_scope()
+        self._exe = executor or Executor()
+        self._engine = self._exe.engine
+        self.buckets = parse_buckets(buckets)
+        if max_wait_ms is None:
+            max_wait_ms = float(flags.get_flag("serving_max_wait_ms"))
+        self.max_wait_ms = float(max_wait_ms)
+        self.name = name
+        self._queue = []
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._started = False
+        self._worker = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._started:
+            return self
+        self._stopping = False
+        self._started = True
+        self._worker = threading.Thread(
+            target=self._loop, name="paddle-tpu-%s" % self.name, daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self):
+        """Drain the queue (every pending future resolves), then stop the
+        worker."""
+        if not self._started:
+            return
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        self._worker.join()
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def warmup(self, example_feed):
+        """Pre-compile every bucket executable from one example request
+        (tiled to each edge) so the first live requests hit the cache
+        instead of paying an XLA compile inside their latency budget."""
+        example = {k: np.asarray(v) for k, v in example_feed.items()}
+        for edge in self.buckets:
+            feed = {k: self._tile(v, edge) for k, v in example.items()}
+            self._run_padded(feed, edge)
+        return self
+
+    # -- client API --------------------------------------------------------
+    def submit(self, feed):
+        """Enqueue one request; returns a concurrent.futures.Future
+        resolving to the fetch list (numpy, rows matching the request)."""
+        from paddle_tpu import observability as obs
+
+        if not self._started:
+            raise RuntimeError("InferenceServer not started (use start() "
+                               "or the context manager)")
+        fd, rows = self._coerce(feed)
+        req = _Request(fd, rows)
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("InferenceServer is stopping")
+            self._queue.append(req)
+            obs.set_gauge("serving.queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req.future
+
+    def run(self, feed, timeout=None):
+        return self.submit(feed).result(timeout)
+
+    # -- worker ------------------------------------------------------------
+    def _loop(self):
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            self._dispatch(batch)
+
+    def _collect(self):
+        """Block until a dispatchable batch exists: the top bucket is
+        full, the oldest request's max-wait expired, or the server is
+        draining. Returns the popped requests (None = drained + stopped).
+        """
+        max_bucket = self.buckets[-1]
+        with self._cond:
+            while not self._queue:
+                if self._stopping:
+                    return None
+                self._cond.wait(0.25)
+            deadline = self._queue[0].t_enq + self.max_wait_ms / 1000.0
+            while (sum(r.rows for r in self._queue) < max_bucket
+                   and not self._stopping):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch, rows = [], 0
+            while self._queue:
+                nxt = self._queue[0]
+                if batch and rows + nxt.rows > max_bucket:
+                    break
+                batch.append(self._queue.pop(0))
+                rows += nxt.rows
+            return batch
+
+    def _dispatch(self, batch):
+        from paddle_tpu import observability as obs
+
+        t_start = time.monotonic()
+        rows = sum(r.rows for r in batch)
+        bucket = self._bucket_for(rows)
+        if obs.enabled():
+            with self._cond:
+                depth = len(self._queue)
+            obs.observe("serving.queue_depth", depth)
+            obs.set_gauge("serving.queue_depth", depth)
+            for r in batch:
+                obs.observe("serving.queue_ms",
+                            (t_start - r.t_enq) * 1000.0)
+        try:
+            feed = self._coalesce(batch, rows, bucket)
+            outs = self._run_padded(feed, bucket)
+            self._resolve(batch, outs, bucket)
+        except BaseException as e:  # noqa: BLE001 - propagate per-request
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        t_done = time.monotonic()
+        if obs.enabled():
+            obs.observe("serving.batch_ms", (t_done - t_start) * 1000.0)
+            obs.observe("serving.batch_fill", rows / float(bucket))
+            for r in batch:
+                obs.observe("serving.request_ms",
+                            (t_done - r.t_enq) * 1000.0)
+            obs.inc("serving.requests", len(batch))
+            obs.inc("serving.batches")
+            obs.inc("serving.padded_rows", bucket - rows)
+
+    # -- internals ---------------------------------------------------------
+    def _coerce(self, feed):
+        fd, rows = {}, None
+        for name in self.feed_names:
+            if name not in feed:
+                raise KeyError("request is missing feed %r" % name)
+            v = np.asarray(feed[name])
+            if v.ndim == 0:
+                raise ValueError("feed %r must carry a leading batch dim"
+                                 % name)
+            if rows is None:
+                rows = int(v.shape[0])
+            elif int(v.shape[0]) != rows:
+                raise ValueError(
+                    "inconsistent batch dims in request: %r has %d rows, "
+                    "expected %d" % (name, v.shape[0], rows))
+            fd[name] = v
+        return fd, rows
+
+    def _bucket_for(self, rows):
+        for edge in self.buckets:
+            if rows <= edge:
+                return edge
+        return rows  # oversized request: exact-shape executable
+
+    def _coalesce(self, batch, rows, bucket):
+        feed = {}
+        for name in self.feed_names:
+            parts = [r.feed[name] for r in batch]
+            joined = parts[0] if len(parts) == 1 else np.concatenate(
+                parts, axis=0)
+            if bucket > rows:
+                pad = np.zeros((bucket - rows,) + joined.shape[1:],
+                               joined.dtype)
+                joined = np.concatenate([joined, pad], axis=0)
+            feed[name] = joined
+        return feed
+
+    def _run_padded(self, feed, bucket):
+        return self._engine.run_block(
+            self.program.desc, 0, self.scope,
+            feed=feed, fetch_list=list(self.fetch_names),
+            is_test=True, donate_state=False, state_writeback=False,
+            cache_key_extra=("serving", self.name, bucket),
+            return_numpy=True)
+
+    def _resolve(self, batch, outs, bucket):
+        # split each fetch along axis 0 when it kept the padded batch
+        # dim; anything else (scalar metrics, reduced outputs) is handed
+        # to every request whole
+        row0 = 0
+        splittable = [
+            hasattr(o, "shape") and getattr(o, "ndim", 0) >= 1
+            and int(o.shape[0]) == bucket for o in outs]
+        for r in batch:
+            vals = []
+            for o, split in zip(outs, splittable):
+                vals.append(o[row0:row0 + r.rows] if split else o)
+            r.future.set_result(vals)
+            row0 += r.rows
+
+    @staticmethod
+    def _tile(v, rows):
+        reps = (int(np.ceil(rows / max(1, v.shape[0]))),) + (1,) * (
+            v.ndim - 1)
+        return np.tile(v, reps)[:rows]

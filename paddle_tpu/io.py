@@ -18,6 +18,7 @@ __all__ = [
     "save_vars", "save_params", "save_persistables",
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model",
+    "save_frozen_model", "load_frozen_model",
     "CheckpointManager", "save_checkpoint_async", "load_checkpoint",
 ]
 
@@ -242,6 +243,69 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     return fetch_names
 
 
+def save_frozen_model(dirname, program, feed_names, fetch_names,
+                      scope=None, quant_meta=None):
+    """Persist a frozen (and possibly INT8-quantized) program produced by
+    ``inference.freeze_program`` / ``quantize_program``: ``__model__``
+    desc bytes + ``__meta__.json`` + every persistable read from the
+    GIVEN scope (freezing runs in a private scope, so the global-scope
+    path of save_persistables would miss the folded/int8 weights).
+    ``quant_meta`` (e.g. a QuantReport summary) rides along in the meta
+    JSON so tooling can tell a quantized artifact from an fp32 one."""
+    if scope is None:
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(program.desc.serialize_to_string())
+    fetch_names = [f.name if hasattr(f, "name") else str(f)
+                   for f in fetch_names]
+    meta = {
+        "feed_names": list(feed_names),
+        "fetch_names": fetch_names,
+        "frozen": True,
+    }
+    if quant_meta is not None:
+        meta["quantization"] = quant_meta
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump(meta, f)
+    arrays = {}
+    gb = program.desc.global_block()
+    for name, vd in gb.vars.items():
+        if not vd.persistable or name in ("feed", "fetch"):
+            continue
+        val = scope.get(name)
+        if val is not None:
+            arrays[name] = np.asarray(val)
+    np.savez(os.path.join(dirname, "__combined__.npz"), **arrays)
+    from paddle_tpu.aot import remove_aot_artifact
+
+    remove_aot_artifact(dirname)
+    return sorted(arrays)
+
+
+def load_frozen_model(dirname, scope=None):
+    """Inverse of save_frozen_model; loads params into the GIVEN scope
+    (default global). Returns (program, feed_names, fetch_names, meta)."""
+    if scope is None:
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+    with open(os.path.join(dirname, "__model__"), "rb") as f:
+        desc = ProgramDescData.parse_from_string(f.read())
+    from paddle_tpu.framework import program_from_desc
+
+    program = program_from_desc(desc)
+    program._is_test = True
+    with open(os.path.join(dirname, "__meta__.json")) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(dirname, "__combined__.npz"))
+    for name in data.files:
+        scope.set(name, data[name])
+    return program, meta["feed_names"], meta["fetch_names"], meta
+
+
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, pserver_endpoints=None):
     """With ``pserver_endpoints`` the persistable params are refreshed
@@ -250,18 +314,9 @@ def load_inference_model(dirname, executor, model_filename=None,
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "rb") as f:
         desc = ProgramDescData.parse_from_string(f.read())
-    program = Program()
-    program.desc = desc
-    desc._version_token = 1
-    program.blocks = [Block(program, i) for i in range(desc.num_blocks())]
-    for b in program.blocks:
-        from paddle_tpu.framework import Variable
+    from paddle_tpu.framework import program_from_desc
 
-        for name, vd in b.desc.vars.items():
-            v = Variable.__new__(Variable)
-            v.block = b
-            v.desc = vd
-            b.vars[name] = v
+    program = program_from_desc(desc)
     program._is_test = True
     with open(os.path.join(dirname, "__meta__.json")) as f:
         meta = json.load(f)

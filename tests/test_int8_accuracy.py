@@ -7,9 +7,19 @@ in BASELINE.md). Rounds 1-4 tested the QAT/calibration mechanics only;
 this test runs the fork's actual discipline end-to-end: train a small
 conv net on MNIST through the repo's own dataset loader + reader
 decorators, post-training-calibrate with the Calibrator, and assert the
-INT8 top-1 accuracy lands within 0.5 percentage points of FP32."""
+INT8 top-1 accuracy lands within 0.5 percentage points of FP32.
+
+The freeze-path tests run the same discipline through the
+paddle_tpu.inference pipeline (freeze_program -> calibrate_program ->
+quantize_program): the frozen program re-verifies clean, the quantized
+top-1 lands within 1 point of fp32, and the BN-fold transform is
+output-parity with the unfolded graph at engine opt 2 (bit-for-bit is
+impossible on principle — folding reassociates the affine math into the
+conv weights, changing float rounding order — so parity is asserted at
+accumulated-rounding tolerance)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu import dataset, nets, reader as ptreader
@@ -54,15 +64,19 @@ def _accuracy(exe, prog, acc, batches):
     return float(np.average(accs, weights=ns))
 
 
-def test_int8_top1_within_half_point_of_fp32():
+@pytest.fixture(scope="module")
+def trained():
+    """One trained LeNet shared by every test in this module: the
+    Calibrator path mutates test_prog in place (the reference contract),
+    so the freeze-path tests work from the untouched ``main`` program —
+    freeze_program strips the training segment itself and never mutates
+    its input."""
     main, startup, test_prog, pred, loss, acc = _lenet_program()
-
     train_reader = ptreader.batch(
         ptreader.shuffle(dataset.mnist.train(), buf_size=512),
         batch_size=64, drop_last=True)
     test_batches = list(ptreader.batch(dataset.mnist.test(),
                                        batch_size=128)())
-
     exe = fluid.Executor()
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
@@ -71,22 +85,130 @@ def test_int8_top1_within_half_point_of_fp32():
             for b in train_reader():
                 exe.run(main, feed=_feed(b), fetch_list=[loss])
         fp32_acc = _accuracy(exe, test_prog, acc, test_batches)
+    return {
+        "main": main, "test_prog": test_prog, "pred": pred, "acc": acc,
+        "exe": exe, "scope": scope, "fp32_acc": fp32_acc,
+        "train_batches": [_feed(b) for b in list(train_reader())[:8]],
+        "test_batches": test_batches,
+    }
 
+
+def test_int8_top1_within_half_point_of_fp32(trained):
+    exe, scope = trained["exe"], trained["scope"]
+    with fluid.scope_guard(scope):
         # post-training calibration over a handful of train batches,
         # through the reference Calibrator surface (sample_data ->
         # save_int8_model flow)
         from paddle_tpu.contrib.int8_inference import Calibrator
 
-        cal = Calibrator(test_prog, scope, exe, ["img"], [pred])
-        cal.sample_data([_feed(b) for b in
-                         list(train_reader())[:8]])
+        cal = Calibrator(trained["test_prog"], scope, exe, ["img"],
+                         [trained["pred"]])
+        cal.sample_data(trained["train_batches"])
         int8_prog = cal.save_int8_model()
         types = [op.type for op in int8_prog.desc.global_block().ops]
         assert "quantized_conv2d" in types and "quantized_matmul" in types
-        int8_acc = _accuracy(exe, int8_prog, acc, test_batches)
+        int8_acc = _accuracy(exe, int8_prog, trained["acc"],
+                             trained["test_batches"])
 
     # the model must actually have learned the task, or the delta is
     # vacuous (synthetic MNIST has class-dependent structure)
-    assert fp32_acc > 0.9, fp32_acc
+    assert trained["fp32_acc"] > 0.9, trained["fp32_acc"]
     # the fork's published discipline: top-1 delta within 0.5 points
-    assert abs(fp32_acc - int8_acc) <= 0.005, (fp32_acc, int8_acc)
+    assert abs(trained["fp32_acc"] - int8_acc) <= 0.005, (
+        trained["fp32_acc"], int8_acc)
+
+
+def _top1(exe, prog, pred_name, batches):
+    """Host-side top-1 over softmax fetches (the frozen program has no
+    label feed or accuracy op — that is the point of freezing)."""
+    hits = total = 0
+    for b in batches:
+        feed = _feed(b)
+        (p,) = exe.run(prog, feed={"img": feed["img"]},
+                       fetch_list=[pred_name])
+        hits += int((np.argmax(np.asarray(p), axis=1)
+                     == feed["label"].reshape(-1)).sum())
+        total += len(b)
+    return hits / float(total)
+
+
+def test_freeze_calibrate_quantize_top1_within_one_point(trained):
+    """The tentpole pipeline: freeze the TRAIN program (strip + prune +
+    fold), calibrate over representative batches, quantize — INT8 top-1
+    within 1 point of fp32, and both programs re-verify clean."""
+    from paddle_tpu.analysis import verify_program
+    from paddle_tpu.inference import freeze_program, post_training_quantize
+
+    exe, scope = trained["exe"], trained["scope"]
+    pred_name = trained["pred"].name
+    with fluid.scope_guard(scope):
+        frozen, rep = freeze_program(
+            trained["main"], ["img"], [pred_name], scope=scope)
+        assert rep.after_ops < rep.before_ops  # training segment gone
+        # the frozen desc re-verifies clean as a standalone program
+        vrep = verify_program(frozen.desc, feed_names=["img"],
+                              fetch_names=[pred_name])
+        assert not vrep.errors, vrep.render()
+
+        calib = [{"img": b["img"]} for b in trained["train_batches"]]
+        int8_prog, stats, qrep = post_training_quantize(
+            frozen, calib, ["img"], [pred_name], scope=scope,
+            executor=exe, max_batches=len(calib))
+        types = [op.type for op in int8_prog.desc.global_block().ops]
+        assert "quantized_conv2d" in types and "quantized_matmul" in types
+        # every quantized op got a calibrated range recorded
+        assert all(q["act_abs_max"] > 0 for q in qrep.quantized)
+        vrep = verify_program(int8_prog.desc, feed_names=["img"],
+                              fetch_names=[pred_name])
+        assert not vrep.errors, vrep.render()
+
+        fp32_top1 = _top1(exe, frozen, pred_name, trained["test_batches"])
+        int8_top1 = _top1(exe, int8_prog, pred_name,
+                          trained["test_batches"])
+    assert fp32_top1 > 0.9, fp32_top1
+    assert abs(fp32_top1 - int8_top1) <= 0.01, (fp32_top1, int8_top1)
+
+
+def test_bn_fold_parity_at_opt2():
+    """conv(bias-free) + batch_norm folds into the conv weights; the
+    folded and unfolded frozen graphs agree at engine opt 2 to
+    accumulated-rounding tolerance (bit-identity is unattainable: the
+    fold reorders the affine arithmetic)."""
+    from paddle_tpu.inference import freeze_program
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4,
+                                   filter_size=3, padding=1,
+                                   bias_attr=False)
+        bn = fluid.layers.batch_norm(input=conv, act="relu")
+        pred = fluid.layers.fc(input=bn, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(7)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(4):  # move the BN running stats off init values
+            exe.run(main, feed={
+                "img": rng.randn(16, 3, 8, 8).astype(np.float32),
+                "label": rng.randint(0, 10, (16, 1)).astype(np.int64),
+            }, fetch_list=[loss])
+
+        folded, rep = freeze_program(main, ["img"], [pred.name],
+                                     scope=scope)
+        assert rep.bn_folds == 1, rep.render()
+        plain, rep2 = freeze_program(main, ["img"], [pred.name],
+                                     scope=scope, fold_batch_norm=False)
+        assert rep2.bn_folds == 0
+        x = {"img": rng.randn(8, 3, 8, 8).astype(np.float32)}
+        (a,) = exe.run(folded, feed=x, fetch_list=[pred.name], opt_level=2)
+        (b,) = exe.run(plain, feed=x, fetch_list=[pred.name], opt_level=2)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=2e-5)

@@ -1,0 +1,220 @@
+"""Continuous-batching InferenceServer behavior
+(paddle_tpu/inference/serving.py): bucket routing, the max-wait
+dispatch timer, per-bucket executable cache keying, SLO histogram
+population, concurrent-client correctness, and the acceptance bound —
+idle and 4x-burst p99 stay bounded by the max-wait timer plus a small
+multiple of one batch's compute (timing asserts carry generous slack:
+the suite shares one CPU core with the worker thread)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (
+    InferenceServer,
+    freeze_program,
+    parse_buckets,
+)
+from paddle_tpu.models import mnist
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One frozen MLP shared by every test (each test builds its own
+    server over it; the scope is read-only under serving)."""
+    main, startup, h = mnist.get_model(lr=0.01)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    frozen, _ = freeze_program(main, ["img"], [h["logits"].name],
+                               scope=scope)
+    return {"program": frozen, "feed_names": ["img"],
+            "fetch_names": [h["logits"].name], "scope": scope,
+            "exe": exe}
+
+
+def _server(served, **kw):
+    kw.setdefault("buckets", (1, 2, 4, 8))
+    kw.setdefault("max_wait_ms", 25.0)
+    return InferenceServer(
+        served["program"], served["feed_names"], served["fetch_names"],
+        scope=served["scope"], executor=served["exe"], **kw)
+
+
+def _mk(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"img": rng.randn(n, 784).astype(np.float32)}
+
+
+def test_parse_buckets():
+    assert parse_buckets("8,1,4,4") == (1, 4, 8)
+    assert parse_buckets([2, 1]) == (1, 2)
+    assert parse_buckets(" 1, 2 ,4") == (1, 2, 4)
+    with pytest.raises(ValueError):
+        parse_buckets("")
+    with pytest.raises(ValueError):
+        parse_buckets([0, -3])
+
+
+def test_bucket_routing(served):
+    srv = _server(served, buckets=(2, 4, 8))
+    # smallest edge that fits; oversize runs at its exact shape
+    assert srv._bucket_for(1) == 2
+    assert srv._bucket_for(2) == 2
+    assert srv._bucket_for(3) == 4
+    assert srv._bucket_for(8) == 8
+    assert srv._bucket_for(9) == 9
+    with srv:
+        out = srv.run(_mk(3))
+    # padded to bucket 4 internally, sliced back to the request's rows
+    assert out[0].shape == (3, 10)
+
+
+def test_max_wait_timer_fires_for_lone_request(served):
+    srv = _server(served, buckets=(8,), max_wait_ms=40.0)
+    with srv:
+        srv.warmup(_mk(1))  # compile outside the timed window
+        t0 = time.monotonic()
+        out = srv.run(_mk(1))
+        elapsed = time.monotonic() - t0
+    assert out[0].shape == (1, 10)
+    # the bucket (8) never fills — only the 40ms timer can dispatch; an
+    # unbounded wait would hang until stop(), so any sub-second result
+    # proves the timer; the lower bound proves it actually waited
+    assert elapsed >= 0.03, elapsed
+    assert elapsed < 2.0, elapsed
+
+
+def test_per_bucket_cache_keying(served):
+    srv = _server(served, buckets=(1, 4), name="cachekey-test")
+    engine = srv._engine
+
+    def tagged():
+        return [k for k in list(engine._cache)
+                if "cachekey-test" in str(k)]
+
+    with srv:
+        srv.warmup(_mk(1))      # compiles both bucket executables
+        assert len(tagged()) == 2
+        srv.run(_mk(1))         # bucket 1: cache hit
+        srv.run(_mk(3))         # padded to bucket 4: cache hit
+        assert len(tagged()) == 2
+        out = srv.run(_mk(9))   # oversize: exact-shape executable
+        assert out[0].shape == (9, 10)
+        assert len(tagged()) == 3
+
+
+def test_slo_histograms_populated(served):
+    obs.set_enabled(True)
+    try:
+        obs.reset()
+        srv = _server(served, buckets=(1, 2, 4), max_wait_ms=5.0)
+        with srv:
+            srv.warmup(_mk(1))
+            for i in range(5):
+                srv.run(_mk(1, seed=i))
+        snap = obs.snapshot()
+        hists = snap["histograms"]
+        assert hists["serving.request_ms"]["count"] == 5
+        assert hists["serving.queue_ms"]["count"] == 5
+        assert hists["serving.request_ms"]["p99"] is not None
+        assert hists["serving.batch_ms"]["count"] >= 1
+        assert 0.0 < hists["serving.batch_fill"]["mean"] <= 1.0
+        assert "serving.queue_depth" in hists
+        assert snap["counters"]["serving.requests"] == 5
+        assert snap["counters"]["serving.batches"] >= 1
+    finally:
+        obs.set_enabled(None)
+        obs.reset()
+
+
+def test_concurrent_clients_match_direct_run(served):
+    feeds = [_mk(1 + i % 3, seed=100 + i) for i in range(12)]
+    exe = served["exe"]
+    with fluid.scope_guard(served["scope"]):
+        expected = [np.asarray(exe.run(
+            served["program"], feed=f,
+            fetch_list=served["fetch_names"])[0]) for f in feeds]
+    srv = _server(served, max_wait_ms=5.0)
+    results = [None] * len(feeds)
+    errors = []
+
+    def client(base):
+        try:
+            for i in range(base, len(feeds), 4):
+                results[i] = srv.run(feeds[i], timeout=60)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    with srv:
+        srv.warmup(_mk(1))
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    for got, want in zip(results, expected):
+        np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-5)
+
+
+def test_stop_drains_pending_futures(served):
+    srv = _server(served, buckets=(8,), max_wait_ms=5000.0)
+    with srv:
+        srv.warmup(_mk(1))
+        fut = srv.submit(_mk(2))  # bucket never fills; timer is 5s out
+        srv.stop()                # drain must resolve it anyway
+    assert fut.result(timeout=1)[0].shape == (2, 10)
+
+
+def test_idle_and_burst_p99_bounded_by_max_wait(served):
+    """The acceptance bound: at 0 QPS (a lone request against an idle
+    server) and under a 4x-capacity burst, p99 stays within the max-wait
+    timer plus a small multiple of one batch's compute."""
+    max_wait_ms = 25.0
+    srv = _server(served, buckets=(1, 2, 4, 8), max_wait_ms=max_wait_ms)
+    obs.set_enabled(True)
+    try:
+        with srv:
+            srv.warmup(_mk(1))
+            # one batch's compute at the top bucket: min of 3 full-bucket
+            # runs (full bucket dispatches without waiting on the timer)
+            t_batch_ms = min(
+                _timed(lambda: srv.run(_mk(8))) for _ in range(3))
+
+            # -- idle: a lone request --
+            obs.reset()
+            srv.run(_mk(1))
+            p99_idle = obs.snapshot()[
+                "histograms"]["serving.request_ms"]["p99"]
+
+            # -- burst: 4x the top bucket submitted at once --
+            obs.reset()
+            futs = [srv.submit(_mk(1, seed=i)) for i in range(32)]
+            for f in futs:
+                f.result(timeout=60)
+            p99_burst = obs.snapshot()[
+                "histograms"]["serving.request_ms"]["p99"]
+    finally:
+        obs.set_enabled(None)
+        obs.reset()
+
+    # slack: 1-core CI boxes timeshare the worker with the clients
+    idle_bound = max_wait_ms + 10 * t_batch_ms + 150
+    assert p99_idle <= idle_bound, (p99_idle, idle_bound, t_batch_ms)
+    # the burst drains in ~ceil(32/8)=4 batches; the last request's
+    # latency carries every earlier batch plus one timer window
+    burst_bound = max_wait_ms + 5 * 8 * t_batch_ms + 500
+    assert p99_burst <= burst_bound, (p99_burst, burst_bound, t_batch_ms)
+
+
+def _timed(fn):
+    t0 = time.monotonic()
+    fn()
+    return (time.monotonic() - t0) * 1000.0

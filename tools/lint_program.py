@@ -19,7 +19,11 @@ level. ``--memory`` additionally prints each main program's memory plan
 (analysis/memory.py): liveness peak + top-10 contributors, the
 donate/held split, and the remat segment choice under ``--budget-mb``
 (default: the device-derived HBM budget, usually absent on CPU — remat
-reads "off"). Exit code 1 iff any ERROR finding.
+reads "off"). ``--freeze`` additionally runs each built model through
+the inference freeze + INT8 post-training-quantization pipeline
+(paddle_tpu.inference) and prints the op/var counts before/after, the
+batch-norm folds, and the quantized-vs-skipped table with per-op
+calibrated ranges. Exit code 1 iff any ERROR finding.
 
   python tools/lint_program.py
   python tools/lint_program.py --list-passes
@@ -27,6 +31,7 @@ reads "off"). Exit code 1 iff any ERROR finding.
   python tools/lint_program.py --mesh dp=4,tp=2 --rule '.*fc.*w:,tp'
   python tools/lint_program.py --program /tmp/main.prog --opt-level 2
   python tools/lint_program.py --model mnist_mlp --memory --budget-mb 4
+  python tools/lint_program.py --model recognize_digits_conv --freeze
 """
 
 import argparse
@@ -149,6 +154,53 @@ def _print_memory_plan(program_or_desc, args, fetch_names=None):
     print(plan.render())
 
 
+def _freeze_report(main, startup, feed_names, fetch_names):
+    """The --freeze report: run the real freeze + PTQ pipeline
+    (inference/freeze.py, inference/quantize.py) over the built model and
+    print the op/var before/after counts, the BN-fold tally, and the
+    quantized-vs-skipped table with each op's calibrated activation
+    range and weight scale."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.executor import Executor
+    from paddle_tpu.inference import freeze_program
+    from paddle_tpu.inference.quantize import (
+        calibrate_program,
+        quantize_desc,
+    )
+
+    exe = Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    frozen, rep = freeze_program(main, feed_names, fetch_names,
+                                 scope=scope)
+    print("-- freeze report --")
+    print(rep.render())
+    # synthetic calibration feeds off the desc shapes (-1 -> small
+    # batch); integer feeds get ones — valid ids for any vocab/label
+    # space of size >= 2 and non-degenerate sequence lengths
+    gb = main.desc.global_block()
+    rng = np.random.RandomState(0)
+    feed = {}
+    for n in feed_names:
+        vd = gb.find_var_recursive(n)
+        shape = [4 if int(d) < 0 else int(d)
+                 for d in (list(vd.shape) or [4])]
+        if "int" in str(vd.dtype).lower():
+            feed[n] = np.ones(shape, np.int64)
+        else:
+            feed[n] = (rng.randn(*shape) * 0.5).astype(np.float32)
+    with fluid.scope_guard(scope):
+        stats = calibrate_program(frozen, [feed, feed], scope=scope,
+                                  executor=exe, max_batches=2)
+        work = frozen.desc.clone()
+        qrep = quantize_desc(work, scope, stats.ranges())
+    print("-- quantization report --")
+    print(qrep.render())
+
+
 def _lint_built_model(name, builder, args):
     from paddle_tpu import unique_name
     from paddle_tpu.analysis import Severity, verify_program
@@ -177,6 +229,13 @@ def _lint_built_model(name, builder, args):
         report.extend(startup_report.findings)
         if args.memory:
             _print_memory_plan(main_desc, args, fetch_names=fetches)
+        if args.freeze:
+            try:
+                _freeze_report(main, startup, feeds, [fetch.name])
+            except Exception as e:  # per-model: a freeze failure is a
+                # report line, not a lint abort
+                print("-- freeze report failed: %s: %s --"
+                      % (type(e).__name__, e))
     finally:
         unique_name.switch(old_gen)
 
@@ -246,6 +305,12 @@ def main(argv=None):
                         help="HBM budget for the --memory remat policy "
                              "(default: device limit x "
                              "PADDLE_TPU_HBM_BUDGET_FRAC, if knowable)")
+    parser.add_argument("--freeze", action="store_true",
+                        help="after linting each built model, run the "
+                             "inference freeze + INT8 PTQ pipeline over "
+                             "it and print the op/var before/after "
+                             "counts, BN folds, and the quantized-vs-"
+                             "skipped table with calibrated ranges")
     parser.add_argument("--list-passes", action="store_true",
                         help="list every registered pass (name, kind, "
                              "default on/off) and exit")

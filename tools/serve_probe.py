@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Serving SLO probe: Poisson load sweep against the continuous-batching
+InferenceServer (paddle_tpu.inference.serving).
+
+Builds a tiny model, freezes it (training ops stripped, BN folded),
+optionally INT8-quantizes it (default on — the production serving
+configuration), then drives the server with a Poisson arrival process at
+each requested QPS level: exponential inter-arrival gaps from a seeded
+RNG, one-row requests submitted asynchronously so queueing behavior is
+the server's own (the driver never throttles on responses; every future
+is drained before the level is scored).
+
+The per-level QPS / p50 / p99 / queue-depth table is assembled FROM THE
+TELEMETRY SINKS, not from driver-side stopwatches: each level attaches a
+fresh observability JsonlSink, the server's ``serving.*`` histograms
+stream into it, and the probe parses the final snapshot event back out —
+the same files a fleet run would ship, so the probe doubles as an
+end-to-end test of the serving SLO export path (the shape of
+multichip_probe.py's gauge round-trip, extended to histograms).
+
+``--slo-ms X --slo-floor-qps Y`` is the CI gate: the probe finds the
+highest offered-load level whose p99 still meets X ms and exits non-zero
+when that level's achieved QPS lands below Y — "the serving path stopped
+meeting its latency budget" as a red build, the serving twin of
+multichip_probe's ``--efficiency-floor``.
+
+Usage:
+  python tools/serve_probe.py --model mlp --qps 5,10,20
+  python tools/serve_probe.py --model resnet50 --no-int8 --duration 3
+  python tools/serve_probe.py --qps 4,8 --slo-ms 100 --slo-floor-qps 4
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+# Probe on the host CPU backend; never grabs TPU devices.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MODELS = ("mlp", "resnet50", "bert")
+
+
+def _build(model, seed):
+    """(main, startup, feed_names, fetch_names, one_row_fn) on tiny CPU
+    geometry — the probe measures the batcher and the SLO pipeline, not
+    the chip."""
+    import numpy as np
+
+    from paddle_tpu import models
+
+    rng = np.random.RandomState(seed)
+    if model == "mlp":
+        main, startup, h = models.mnist.get_model(lr=0.01)
+
+        def one_row():
+            return {"img": rng.randn(1, 784).astype(np.float32)}
+
+        return main, startup, ["img"], [h["logits"].name], one_row
+    if model == "resnet50":
+        # cifar resnet at depth 20: the real conv/BN graph (BN folding +
+        # per-channel conv quantization exercised) without imagenet-sized
+        # CPU step times — the multichip_probe naming convention
+        main, startup, h = models.resnet.get_model(
+            dataset="cifar10", depth=20, class_num=10, lr=0.1)
+
+        def one_row():
+            return {"img": rng.randn(1, 3, 32, 32).astype(np.float32)}
+
+        return main, startup, ["img"], [h["logits"].name], one_row
+    if model == "bert":
+        kw = dict(d_model=64, n_layers=2, n_heads=2, d_inner=128)
+        main, startup, h = models.bert.get_model(
+            batch_size=4, seq_len=32, vocab_size=512, dropout=0.0,
+            lr=1e-4, max_position=512, **kw)
+        enc_feeds = ["src_ids", "pos_ids", "sent_ids", "seq_lens"]
+
+        def one_row():
+            b = models.bert.make_fake_batch(1, 32, 512, kw["n_heads"],
+                                            rng=rng)
+            return {k: b[k] for k in enc_feeds}
+
+        return main, startup, enc_feeds, [h["enc_out"].name], one_row
+    raise ValueError("unknown model %r (want one of %s)" % (model, MODELS))
+
+
+def build_server(model="mlp", int8=True, calib_batches=4, buckets=None,
+                 max_wait_ms=None, seed=0):
+    """Freeze (+quantize) the model and wrap it in an InferenceServer
+    (not yet started). Returns (server, one_row_fn, build_info)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.executor import Executor
+    from paddle_tpu.inference import (
+        InferenceServer,
+        freeze_program,
+        post_training_quantize,
+    )
+
+    main, startup, feed_names, fetch_names, one_row = _build(model, seed)
+    exe = Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    frozen, freeze_rep = freeze_program(
+        main, feed_names, fetch_names, scope=scope)
+    info = {"model": model, "freeze": freeze_rep.render(),
+            "bn_folds": freeze_rep.bn_folds, "int8": bool(int8)}
+    program = frozen
+    if int8:
+        batches = []
+        for _ in range(calib_batches):
+            rows = [one_row() for _ in range(4)]
+            batches.append({k: np.concatenate([r[k] for r in rows])
+                            for k in feed_names})
+        program, _, qrep = post_training_quantize(
+            frozen, batches, feed_names, fetch_names, scope=scope,
+            executor=exe, max_batches=calib_batches)
+        info["quantized_ops"] = len(qrep.quantized)
+        info["skipped_ops"] = len(qrep.skipped)
+    server = InferenceServer(program, feed_names, fetch_names, scope=scope,
+                             executor=exe, buckets=buckets,
+                             max_wait_ms=max_wait_ms, name="probe")
+    return server, one_row, info
+
+
+def _poisson_level(server, one_row, qps, duration, rng):
+    """Offer ``qps`` for ``duration`` seconds with exponential gaps;
+    drain every future. Returns (n_requests, elapsed_seconds)."""
+    futures = []
+    t0 = time.monotonic()
+    t_end = t0 + duration
+    next_t = t0
+    while True:
+        next_t += rng.exponential(1.0 / qps)
+        if next_t >= t_end:
+            break
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        futures.append(server.submit(one_row()))
+    for f in futures:
+        f.result(timeout=600)
+    return len(futures), time.monotonic() - t0
+
+
+def _read_sink_serving(path):
+    """serving.* histograms + counters from the last metrics snapshot of
+    a JSONL sink file (detach_sink emits one on exit)."""
+    metrics = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("t") == "snap":
+                    metrics = ev.get("metrics") or metrics
+    except OSError:
+        return None
+    if not metrics:
+        return None
+    return {"histograms": metrics.get("histograms") or {},
+            "counters": metrics.get("counters") or {}}
+
+
+def probe_serving(server, one_row, qps_levels, duration=2.0, seed=0,
+                  sink_dir=None):
+    """Run the sweep; returns a list of per-level dicts (scored from the
+    telemetry sinks)."""
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+
+    if sink_dir is None:
+        sink_dir = tempfile.mkdtemp(prefix="serve_probe_")
+    obs.set_enabled(True)
+    rows = []
+    with server:
+        server.warmup(one_row())
+        for qps in qps_levels:
+            sink = os.path.join(sink_dir, "serve_qps%g.jsonl" % qps)
+            obs.reset()
+            obs.attach_sink(sink)
+            rng = np.random.RandomState(seed)
+            n, elapsed = _poisson_level(server, one_row, qps, duration,
+                                        rng)
+            obs.detach_sink()
+            m = _read_sink_serving(sink) or {"histograms": {},
+                                             "counters": {}}
+            req = m["histograms"].get("serving.request_ms") or {}
+            depth = m["histograms"].get("serving.queue_depth") or {}
+            fill = m["histograms"].get("serving.batch_fill") or {}
+            rows.append({
+                "qps_offered": qps,
+                "qps_achieved": n / elapsed if elapsed else 0.0,
+                "requests": n,
+                "served": int(m["counters"].get("serving.requests", 0)),
+                "batches": int(m["counters"].get("serving.batches", 0)),
+                "p50_ms": req.get("p50"),
+                "p99_ms": req.get("p99"),
+                "queue_depth_mean": depth.get("mean"),
+                "batch_fill_mean": fill.get("mean"),
+            })
+    obs.set_enabled(None)
+    return rows
+
+
+def render_table(rows):
+    hdr = "%-10s %-10s %-8s %-9s %-9s %-11s %s" % (
+        "offered", "achieved", "batches", "p50 ms", "p99 ms",
+        "queue", "fill")
+    out = [hdr]
+    for r in rows:
+        out.append("%-10g %-10.2f %-8d %-9s %-9s %-11s %s" % (
+            r["qps_offered"], r["qps_achieved"], r["batches"],
+            _fmt(r["p50_ms"]), _fmt(r["p99_ms"]),
+            _fmt(r["queue_depth_mean"]), _fmt(r["batch_fill_mean"])))
+    return "\n".join(out)
+
+
+def _fmt(v):
+    return "%.2f" % v if isinstance(v, (int, float)) else "-"
+
+
+def slo_gate(rows, slo_ms, floor_qps):
+    """Highest achieved QPS among levels meeting the p99 SLO; exit-1
+    verdict when it undercuts the floor."""
+    ok = [r["qps_achieved"] for r in rows
+          if r["p99_ms"] is not None and r["p99_ms"] <= slo_ms]
+    best = max(ok) if ok else 0.0
+    return best, best >= floor_qps
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="mlp", choices=MODELS)
+    ap.add_argument("--qps", default="4,8,16",
+                    help="comma-separated offered QPS levels to sweep")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds of load per level")
+    ap.add_argument("--no-int8", dest="int8", action="store_false",
+                    help="serve the fp32 frozen program (skip PTQ)")
+    ap.add_argument("--calib-batches", type=int, default=4)
+    ap.add_argument("--buckets", default=None,
+                    help="bucket edges, e.g. 1,2,4,8 (default: the "
+                         "serving_buckets flag)")
+    ap.add_argument("--max-wait-ms", type=float, default=None,
+                    help="dispatch deadline (default: the "
+                         "serving_max_wait_ms flag)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sink-dir", default=None,
+                    help="directory for the per-level telemetry sinks "
+                         "(default: a fresh temp dir)")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="p99 latency SLO for the CI gate")
+    ap.add_argument("--slo-floor-qps", type=float, default=0.0,
+                    help="exit 1 if the best QPS meeting --slo-ms is "
+                         "below this")
+    args = ap.parse_args(argv)
+
+    qps_levels = [float(q) for q in args.qps.split(",") if q.strip()]
+    server, one_row, info = build_server(
+        args.model, int8=args.int8, calib_batches=args.calib_batches,
+        buckets=args.buckets, max_wait_ms=args.max_wait_ms,
+        seed=args.seed)
+    print("== %s (%s) ==" % (args.model,
+                             "int8" if args.int8 else "fp32"))
+    if "quantized_ops" in info:
+        print("quantized %d op(s), skipped %d" % (
+            info["quantized_ops"], info["skipped_ops"]))
+    rows = probe_serving(server, one_row, qps_levels,
+                         duration=args.duration, seed=args.seed,
+                         sink_dir=args.sink_dir)
+    print(render_table(rows))
+    summary = {"model": args.model, "int8": args.int8, "levels": rows}
+    print(json.dumps(summary))
+    if args.slo_ms is not None:
+        best, ok = slo_gate(rows, args.slo_ms, args.slo_floor_qps)
+        print("slo: best qps with p99<=%.1fms: %.2f (floor %.1f)"
+              % (args.slo_ms, best, args.slo_floor_qps))
+        if not ok:
+            sys.stderr.write(
+                "serving SLO gate failed: %.2f qps under p99<=%.1fms "
+                "is below the %.1f floor\n"
+                % (best, args.slo_ms, args.slo_floor_qps))
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
