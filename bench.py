@@ -867,6 +867,59 @@ def bench_serving():
     return out
 
 
+def bench_health_overhead():
+    """Cost of the liveness layer at each of its three seams — proving
+    the health PR stays off the step path:
+
+    * ``note_step_ns`` — the ONE call the engine makes per step (an int
+      bump + a clock read); must stay in the ns regime.
+    * ``heartbeat_emit_us`` — one full heartbeat build+emit (RSS read,
+      phase, tracer event, sink flush attempt); runs on a daemon thread
+      once per second, so µs here is noise.
+    * ``classify_8rank_us`` — one supervisor classification round over
+      8 synthetic ranks; runs in wait_gang's poll loop.
+    """
+    import time
+
+    from paddle_tpu.observability import health
+
+    out = {}
+    health.reset_steps()
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        health.note_step()
+    out["note_step_ns"] = round((time.perf_counter() - t0) / n * 1e9, 1)
+
+    em = health.HeartbeatEmitter(interval_ms=60000.0)
+    beats = 200
+    t0 = time.perf_counter()
+    for _ in range(beats):
+        em.emit_now()
+    out["heartbeat_emit_us"] = round(
+        (time.perf_counter() - t0) / beats * 1e6, 2)
+    out["heartbeats_emitted"] = beats
+
+    ranks = {}
+    base = 1700000000.0
+    for r in range(8):
+        rh = ranks[r] = health.RankHealth(r, heartbeat_ms=1000.0)
+        for i in range(32):
+            rh.observe({"name": health.HEARTBEAT_EVENT,
+                        "ts": (base + i) * 1e6,
+                        "args": {"seq": i + 1, "step": i * 3}})
+    rounds = 1000
+    now = base + 33.0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for rh in ranks.values():
+            rh.status(now, 0.0, base)
+    out["classify_8rank_us"] = round(
+        (time.perf_counter() - t0) / rounds * 1e6, 2)
+    health.reset_steps()
+    return out
+
+
 def main():
     from paddle_tpu import flags, observability
 
@@ -1031,6 +1084,13 @@ def main():
         # the serving SLO numbers ride in counters too, so BENCH_*.json
         # trend tooling that only diffs the counters object sees them
         result["counters"]["serving"] = serving_metrics
+    try:
+        # liveness-layer on-path overhead (note_step/emit/classify):
+        # tracked per round so a regression onto the step path is a
+        # visible counters diff, not a silent throughput tax
+        result["counters"]["health"] = bench_health_overhead()
+    except Exception as e:  # noqa: BLE001
+        errors["health"] = str(e)[:200]
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

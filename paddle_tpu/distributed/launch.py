@@ -60,7 +60,7 @@ def launch_processes(script_args, nproc=1, started_port=6170,
     return procs
 
 
-def wait_gang(procs, poll_interval=0.1, term_grace=10.0):
+def wait_gang(procs, poll_interval=0.1, term_grace=10.0, monitor=None):
     """Poll ALL workers until the gang resolves; returns the gang rc.
 
     The seed launcher's sequential ``p.wait()`` hung forever when a
@@ -68,7 +68,16 @@ def wait_gang(procs, poll_interval=0.1, term_grace=10.0):
     collective/barrier. Polling sees the first failure wherever it
     lands; the surviving gang is then terminated (SIGTERM, ``term_grace``
     seconds, then SIGKILL) and the first failing worker's rc propagates.
-    All-zero exits return 0."""
+    All-zero exits return 0.
+
+    With a ``monitor`` (observability.health.HealthMonitor over the
+    workers' sink files) the poll loop also watches LIVENESS: when a
+    still-running rank is classified hung (heartbeats fresh, step
+    counter stalled past the hang timeout) or dead (heartbeats stopped),
+    the gang is terminated the same way and ``health.HUNG_EXIT_CODE``
+    is returned — a hung collective no longer blocks the job forever.
+    Only ranks whose process is still alive are consulted: a worker
+    that exited 0 stops heartbeating legitimately."""
     while True:
         rcs = [p.poll() for p in procs]
         failed = next((rc for rc in rcs if rc not in (None, 0)), None)
@@ -77,6 +86,26 @@ def wait_gang(procs, poll_interval=0.1, term_grace=10.0):
             return failed
         if all(rc == 0 for rc in rcs):
             return 0
+        if monitor is not None:
+            monitor.poll()
+            live = [i for i, rc in enumerate(rcs) if rc is None]
+            bad = monitor.unhealthy(ranks=live)
+            if bad:
+                from paddle_tpu import observability as obs
+                from paddle_tpu.observability import health
+
+                desc = ",".join("%d:%s" % (r, s)
+                                for r, s in sorted(bad.items()))
+                obs.inc("health.hangs_detected")
+                # direct tracer event: the incident record must land in
+                # the supervisor's sink even with metrics gated off
+                obs.tracer.event("health.hang_detected", ranks=desc)
+                obs.flush_sink()
+                print("paddle_tpu.launch: unhealthy rank(s) %s — "
+                      "terminating the gang" % desc,
+                      file=sys.stderr, flush=True)
+                _terminate_survivors(procs, term_grace)
+                return health.HUNG_EXIT_CODE
         time.sleep(poll_interval)
 
 
@@ -102,7 +131,7 @@ def _terminate_survivors(procs, term_grace=10.0):
 def supervise(script_args, nproc=1, started_port=6170,
               node_ip="127.0.0.1", env_extra=None, max_restarts=None,
               recovery_dir=None, backoff=None, capture_output=False,
-              on_gang=None):
+              on_gang=None, heartbeat_ms=None, hang_timeout_s=None):
     """Launch the gang under supervision; returns the final rc.
 
     Restarts the WHOLE gang (terminate survivors, backoff, respawn) on
@@ -112,27 +141,53 @@ def supervise(script_args, nproc=1, started_port=6170,
     entries fire once per job, not once per incarnation) and, when
     ``recovery_dir`` is given, ``PADDLE_TPU_RECOVERY_CKPT`` to resume
     from. ``on_gang(procs, attempt)`` observes each spawned gang
-    (tests)."""
+    (tests).
+
+    Liveness: whenever a metrics sink is configured for the workers,
+    heartbeats are auto-enabled (``PADDLE_TPU_HEARTBEAT_MS`` exported
+    per worker; ``heartbeat_ms``/the flag override the default) and a
+    fresh ``health.HealthMonitor`` per incarnation tails the per-rank
+    sink files, so a hung rank restarts the gang the same way a dead
+    one does (``hang_timeout_s`` / PADDLE_TPU_HANG_TIMEOUT_S; 0 =
+    step-latency-EWMA auto)."""
     from paddle_tpu import flags
     from paddle_tpu import observability as obs
+    from paddle_tpu.observability import health
+    from paddle_tpu.observability.export import host_tagged_path
     from paddle_tpu.resilience.retrying import Backoff
 
     if max_restarts is None:
         max_restarts = int(flags.get_flag("max_restarts"))
     backoff = backoff if backoff is not None else Backoff(
         base=0.5, factor=2.0, cap=30.0, jitter=0.5)
+    sink_base = ((env_extra or {}).get("PADDLE_TPU_METRICS_SINK")
+                 or os.environ.get("PADDLE_TPU_METRICS_SINK"))
+    if heartbeat_ms is not None:
+        hb_ms = float(heartbeat_ms)
+    else:
+        raw = (env_extra or {}).get("PADDLE_TPU_HEARTBEAT_MS")
+        hb_ms = float(raw) if raw else float(flags.get_flag("heartbeat_ms"))
+        if hb_ms <= 0 and sink_base:
+            hb_ms = health.DEFAULT_SUPERVISED_HEARTBEAT_MS
     attempt = 0
     while True:
         env = dict(env_extra or {})
         env["PADDLE_TPU_RESTART_COUNT"] = str(attempt)
         if recovery_dir:
             env["PADDLE_TPU_RECOVERY_CKPT"] = recovery_dir
+        monitor = None
+        if sink_base and hb_ms > 0:
+            # the monitor and the workers must agree on the interval
+            env["PADDLE_TPU_HEARTBEAT_MS"] = str(hb_ms)
+            monitor = health.HealthMonitor(
+                {r: host_tagged_path(sink_base, r) for r in range(nproc)},
+                heartbeat_ms=hb_ms, hang_timeout_s=hang_timeout_s)
         procs = launch_processes(script_args, nproc, started_port,
                                  node_ip, env_extra=env,
                                  capture_output=capture_output)
         if on_gang is not None:
             on_gang(procs, attempt)
-        rc = wait_gang(procs)
+        rc = wait_gang(procs, monitor=monitor)
         if rc == 0:
             return 0
         if attempt >= max_restarts:
@@ -163,6 +218,16 @@ def main():
                         help="checkpoint root exported to workers as "
                              "PADDLE_TPU_RECOVERY_CKPT (default: the "
                              "PADDLE_TPU_RECOVERY_CKPT flag)")
+    parser.add_argument("--heartbeat-ms", type=float, default=None,
+                        help="worker liveness heartbeat interval "
+                             "(default: the PADDLE_TPU_HEARTBEAT_MS "
+                             "flag; auto-enabled at 1000ms when a "
+                             "metrics sink is configured)")
+    parser.add_argument("--hang-timeout", type=float, default=None,
+                        help="seconds of step-counter stall before a "
+                             "heartbeating rank is hung (default: the "
+                             "PADDLE_TPU_HANG_TIMEOUT_S flag; 0 = "
+                             "step-latency-EWMA auto)")
     parser.add_argument("script", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.script:
@@ -171,7 +236,9 @@ def main():
         or None
     sys.exit(supervise(args.script, args.nproc, args.started_port,
                        args.node_ip, max_restarts=args.max_restarts,
-                       recovery_dir=recovery_dir))
+                       recovery_dir=recovery_dir,
+                       heartbeat_ms=args.heartbeat_ms,
+                       hang_timeout_s=args.hang_timeout))
 
 
 if __name__ == "__main__":

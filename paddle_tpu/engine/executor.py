@@ -102,8 +102,12 @@ class Engine:
         ctx mgr when PADDLE_TPU_METRICS is down)."""
         with obs.span("step", step=self._run_counter + 1), \
                 obs.time_block("engine.step_ms"):
-            return self._run_block_impl(program_desc, block_idx, scope,
-                                        **kwargs)
+            out = self._run_block_impl(program_desc, block_idx, scope,
+                                       **kwargs)
+        # liveness: the heartbeat reports this monotonic counter; a rank
+        # whose heartbeats stay fresh while it stops moving is hung
+        obs.health.note_step()
+        return out
 
     def _run_block_impl(
         self,

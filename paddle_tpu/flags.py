@@ -156,8 +156,9 @@ DEFS = {
         "(paddle_tpu.resilience.faultinject): ';'-separated "
         "point@cond:cond entries, e.g. "
         "'step_nan@7;worker_kill@rank1:step12'. Points: step_nan, "
-        "step_fail, compile, ckpt_write, worker_kill. Empty = no "
-        "faults (the production default; the check is one env read)."),
+        "step_fail, compile, ckpt_write, worker_kill, worker_hang. "
+        "Empty = no faults (the production default; the check is one "
+        "env read)."),
     "recovery_ckpt": (
         str, "",
         "Checkpoint root a restarted worker resumes from. The "
@@ -165,6 +166,36 @@ DEFS = {
         "--recovery-dir; training scripts pass it to a "
         "CheckpointManager + resilience.ResilientDriver, which "
         "restores the latest complete step on startup."),
+    "heartbeat_ms": (
+        float, 0.0,
+        "Per-rank liveness heartbeat interval in ms "
+        "(observability/health.py): a daemon thread writes "
+        "health.heartbeat events (monotonic step counter, current span "
+        "phase, host RSS, hbm watermark, serving queue depth) through "
+        "the telemetry sink / flight recorder and flushes the sink, so "
+        "a supervisor tailing the file sees liveness without waiting "
+        "for an exit code. Bypasses the PADDLE_TPU_METRICS gate. "
+        "0 = off; the supervised launcher auto-enables it for workers "
+        "whenever a metrics sink is configured."),
+    "hang_timeout_s": (
+        float, 0.0,
+        "Hung-worker threshold of the supervisor's HealthMonitor "
+        "(observability/health.py): a rank whose heartbeats stay fresh "
+        "but whose step counter has not advanced for this long is "
+        "classified hung; wait_gang terminates the gang (rc 44) and "
+        "supervise restarts it within the restart budget. 0 = auto: a "
+        "multiple of the rank's recent step-latency EWMA, floored at a "
+        "few heartbeat intervals (300s before any step has completed, "
+        "so a cold XLA compile never reads as a hang)."),
+    "serving_slo_ms": (
+        float, 0.0,
+        "Per-request latency SLO of the continuous-batching "
+        "InferenceServer, in ms: requests slower than this spend error "
+        "budget in the fast/slow burn-rate windows "
+        "(observability/health.SloMonitor); sustained burn in both "
+        "windows emits an edge-triggered health.slo_burn event and "
+        "flips InferenceServer.health() to unhealthy (the readiness "
+        "probe). 0 = no SLO monitor."),
     "serving_buckets": (
         str, "1,2,4,8,16,32",
         "Padded batch-size bucket edges of the continuous-batching "

@@ -24,6 +24,14 @@ metrics registry): ``serving.request_ms`` (submit -> result),
 (histogram, sampled at each dispatch; also a live gauge), counters
 ``serving.requests`` / ``serving.batches`` / ``serving.padded_rows``.
 
+Readiness (ungated): with an SLO configured (``slo_ms`` ctor arg /
+``PADDLE_TPU_SERVING_SLO_MS``) every request's latency also feeds an
+``observability.health.SloMonitor`` — fast/slow burn-rate windows whose
+sustained burn flips ``health()`` to unhealthy and emits an
+edge-triggered ``health.slo_burn`` event. ``health()`` is the probe a
+load balancer polls: worker liveness, queue depth, p99, burn rates,
+last-dispatch age.
+
 Concurrency note (PAPERS.md arXiv:2011.03641): keeping the device
 saturated comes from coalescing, not from parallel dispatch — a single
 worker feeding padded buckets to one async engine stream is the whole
@@ -77,9 +85,10 @@ class InferenceServer:
 
     def __init__(self, program, feed_names, fetch_names, scope=None,
                  executor=None, buckets=None, max_wait_ms=None,
-                 name="serving"):
+                 name="serving", slo_ms=None):
         from paddle_tpu import flags
         from paddle_tpu.executor import Executor, global_scope
+        from paddle_tpu.observability.health import SloMonitor
 
         self.program = program
         self.feed_names = tuple(feed_names)
@@ -93,11 +102,19 @@ class InferenceServer:
             max_wait_ms = float(flags.get_flag("serving_max_wait_ms"))
         self.max_wait_ms = float(max_wait_ms)
         self.name = name
+        if slo_ms is None:
+            slo_ms = float(flags.get_flag("serving_slo_ms"))
+        # latency SLO burn-rate monitor (observability/health.py): fed
+        # unconditionally in _dispatch — readiness is not gated by the
+        # metrics flag
+        self.slo = SloMonitor(slo_ms, name=name) \
+            if slo_ms and slo_ms > 0 else None
         self._queue = []
         self._cond = threading.Condition()
         self._stopping = False
         self._started = False
         self._worker = None
+        self._last_dispatch = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -159,6 +176,35 @@ class InferenceServer:
     def run(self, feed, timeout=None):
         return self.submit(feed).result(timeout)
 
+    def health(self):
+        """Readiness snapshot for a load-balancer probe: healthy =
+        worker thread alive AND (with an SLO configured) not burning
+        error budget in both burn-rate windows. Always includes queue
+        depth, p99, and the age of the last dispatch."""
+        from paddle_tpu import observability as obs
+
+        now = time.monotonic()
+        with self._cond:
+            depth = len(self._queue)
+        alive = bool(self._started and self._worker is not None
+                     and self._worker.is_alive())
+        out = {"name": self.name, "started": self._started,
+               "worker_alive": alive, "queue_depth": depth,
+               "last_dispatch_age_s":
+                   (now - self._last_dispatch)
+                   if self._last_dispatch is not None else None}
+        healthy = alive
+        if self.slo is not None:
+            snap = self.slo.snapshot(now=now)
+            out["slo"] = snap
+            out["p99_ms"] = snap["p99_ms"]
+            healthy = healthy and not snap["burning"]
+        else:
+            h = obs.registry.histogram("serving.request_ms")
+            out["p99_ms"] = h.percentile(99) if h is not None else None
+        out["healthy"] = healthy
+        return out
+
     # -- worker ------------------------------------------------------------
     def _loop(self):
         while True:
@@ -218,6 +264,16 @@ class InferenceServer:
                     r.future.set_exception(e)
             return
         t_done = time.monotonic()
+        self._last_dispatch = t_done
+        if self.slo is not None:
+            # a sick SLO monitor must never take the dispatch loop down
+            # (every queued future would hang unresolved)
+            try:
+                for r in batch:
+                    self.slo.record((t_done - r.t_enq) * 1000.0,
+                                    now=t_done)
+            except Exception:
+                pass
         if obs.enabled():
             obs.observe("serving.batch_ms", (t_done - t_start) * 1000.0)
             obs.observe("serving.batch_fill", rows / float(bucket))

@@ -28,7 +28,7 @@ these host spans together with the jax device trace.
 """
 
 from paddle_tpu import flags
-from paddle_tpu.observability import export, memory  # noqa: F401
+from paddle_tpu.observability import export, health, memory  # noqa: F401
 from paddle_tpu.observability.export import (  # noqa: F401
     FlightRecorder,
     JsonlSink,
@@ -51,7 +51,7 @@ __all__ = [
     "FlightRecorder", "JsonlSink", "MetricsRegistry", "SpanTracer",
     "attach_sink", "counter_value", "detach_sink", "dump_chrome_trace",
     "enabled", "event", "flush_sink", "inc", "observe", "registry",
-    "reset", "set_enabled", "set_gauge", "sink", "snapshot",
+    "health", "reset", "set_enabled", "set_gauge", "sink", "snapshot",
     "snapshot_text", "span", "spans", "time_block", "tracer",
 ]
 
@@ -155,6 +155,13 @@ flags.on_change("flight_recorder_depth",
 if flags.get_flag("metrics_sink"):
     # PADDLE_TPU_METRICS_SINK in the environment: stream from import on.
     attach_sink()
+
+flags.on_change("heartbeat_ms", lambda _v: health.ensure_heartbeat())
+
+if float(flags.get_flag("heartbeat_ms") or 0) > 0:
+    # PADDLE_TPU_HEARTBEAT_MS in the environment (the supervised
+    # launcher sets it per worker): liveness beats from import on.
+    health.ensure_heartbeat()
 
 
 # -- metrics ---------------------------------------------------------------

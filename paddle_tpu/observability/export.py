@@ -249,6 +249,76 @@ class JsonlSink:
         return self._rotated_paths() + [self.path]
 
 
+class SinkTail:
+    """Incremental reader of a live JSONL sink file (hoisted from
+    tools/tpu_top.py so the supervisor's HealthMonitor and the live top
+    view share one rotation-safe tail). Yields complete events only (a
+    torn final line is retried on the next poll) and survives size-based
+    rotation: a shrink means the content moved to ``<path>.<seq>`` — the
+    unread tail of the newest rotation is drained first, then the new
+    live file from offset 0."""
+
+    def __init__(self, path):
+        self.path = path
+        self.offset = 0
+        self._carry = ""
+
+    def _read_from(self, path, offset):
+        try:
+            with open(path, encoding="utf-8") as f:
+                f.seek(offset)
+                data = f.read()
+        except OSError:
+            return "", offset
+        return data, offset + len(data)
+
+    def _newest_rotation(self):
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        base = os.path.basename(self.path) + "."
+        best, best_seq = None, -1
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return None
+        for name in names:
+            if name.startswith(base) and name[len(base):].isdigit():
+                seq = int(name[len(base):])
+                if seq > best_seq:
+                    best, best_seq = os.path.join(d, name), seq
+        return best
+
+    def poll(self):
+        """-> list of new event dicts since the last poll."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        chunks = []
+        if size < self.offset:
+            # rotated away: drain what we had not read from the segment
+            # that now lives under the newest rotation suffix
+            rotated = self._newest_rotation()
+            if rotated:
+                data, _ = self._read_from(rotated, self.offset)
+                chunks.append(data)
+            self.offset = 0
+        data, self.offset = self._read_from(self.path, self.offset)
+        chunks.append(data)
+        text = self._carry + "".join(chunks)
+        lines = text.split("\n")
+        self._carry = lines.pop()  # "" on a complete final line
+        events = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+        return events
+
+
 def iter_events(path):
     """Yield event dicts from one JSONL sink file, skipping the torn
     final line a live tail can leave."""

@@ -140,6 +140,11 @@ class MetricsRegistry:
             c = self._counters.get(name)
             return c.value if c is not None else default
 
+    def gauge_value(self, name, default=None):
+        with self._lock:
+            g = self._gauges.get(name)
+            return g.value if g is not None else default
+
     def histogram(self, name):
         with self._lock:
             return self._histograms.get(name)

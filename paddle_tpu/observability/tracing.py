@@ -57,6 +57,11 @@ class SpanTracer:
         self._max_spans = max_spans
         self._sink = None
         self._flight = FlightRecorder(flight_depth or DEFAULT_FLIGHT_DEPTH)
+        # name of the most recently entered open span, process-wide —
+        # the "what is this worker doing" field the health heartbeat
+        # reports. Plain attribute write on span enter/exit (no lock:
+        # an approximate label, read racily by the heartbeat thread).
+        self._phase_name = None
 
     # -- record -----------------------------------------------------------
     def _stack(self):
@@ -116,6 +121,10 @@ class SpanTracer:
     def span(self, name, **args):
         return _Span(self, name, args)
 
+    def current_phase(self):
+        """The innermost open span's name (any thread), or None."""
+        return self._phase_name
+
     def event(self, name, **args):
         """Zero-duration instant marker (chrome-trace "i" events) — e.g.
         a nan/inf-guard trip, a cache eviction."""
@@ -141,6 +150,7 @@ class SpanTracer:
             self._spans = []
             self._dropped = 0
             self._flight.clear()
+            self._phase_name = None
 
     def chrome_trace_events(self, pid=1, process_name="paddle_tpu host"):
         """Chrome-trace event dicts for every recorded span: per-process
@@ -228,6 +238,7 @@ class _Span:
         stack = self.tracer._stack()
         self._depth = len(stack)
         stack.append(self)
+        self.tracer._phase_name = self.name
         self._t0_ns = time.perf_counter_ns()
         return self
 
@@ -236,6 +247,7 @@ class _Span:
         stack = self.tracer._stack()
         if stack and stack[-1] is self:
             stack.pop()
+        self.tracer._phase_name = stack[-1].name if stack else None
         self.tracer._add(SpanRecord(
             self.name, (_EPOCH_ANCHOR_NS + self._t0_ns) / 1e3,
             dur_ns / 1e3, threading.get_ident(), self._depth, self.args))

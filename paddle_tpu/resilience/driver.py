@@ -165,10 +165,12 @@ class ResilientDriver:
         skip = set()
         step = start_step
         while step < n_steps:
-            # worker-liveness fault point: a supervised-launcher test
-            # kills this process here, between steps — the preemption
-            # seam (never mid-device-step in real life either)
+            # worker-liveness fault points: a supervised-launcher test
+            # kills (or wedges, for the heartbeat watchdog) this process
+            # here, between steps — the preemption seam (never
+            # mid-device-step in real life either)
             fault_point("worker_kill", step=step)
+            fault_point("worker_hang", step=step)
             if step in skip:
                 obs.inc("recovery.batch_skipped")
                 step += 1

@@ -41,9 +41,16 @@ Registered points and what firing does:
                  repetitions fail the save)
     worker_kill  hard process exit with KILLED_EXIT_CODE — no cleanup,
                  no atexit: the closest a test gets to SIGKILL/preemption
+    worker_hang  sleep forever WITHOUT exiting: the step loop wedges
+                 while daemon threads (the health heartbeat) keep
+                 running — a deadlocked collective's exact signature.
+                 Only the supervisor's heartbeat watchdog
+                 (observability/health.py) can clear it; restart-gated
+                 like worker_kill so the respawned gang does not re-hang
 """
 
 import os
+import time
 
 from paddle_tpu import flags
 
@@ -58,7 +65,8 @@ KILLED_EXIT_CODE = 43
 POISON_POINTS = frozenset(["step_nan"])
 
 KNOWN_POINTS = frozenset(
-    ["step_nan", "step_fail", "compile", "ckpt_write", "worker_kill"])
+    ["step_nan", "step_fail", "compile", "ckpt_write", "worker_kill",
+     "worker_hang"])
 
 
 class InjectedFault(RuntimeError):
@@ -146,7 +154,7 @@ def random_spec(seed, n_steps, nproc=1, kinds=("worker_kill", "step_nan")):
     parts = []
     for kind in kinds:
         conds = ["step%d" % rng.randint(lo, hi)]
-        if kind == "worker_kill":
+        if kind in ("worker_kill", "worker_hang"):
             conds.insert(0, "rank%d" % rng.randrange(nproc))
         parts.append(kind + "@" + ":".join(conds))
     return ";".join(parts)
@@ -227,6 +235,17 @@ def fault_point(name, step=None):
         except Exception:
             pass
         os._exit(KILLED_EXIT_CODE)
+    if name == "worker_hang":
+        # wedge the step loop forever WITHOUT exiting: the heartbeat
+        # daemon keeps beating with a frozen step counter — exactly the
+        # hung signature the supervisor's HealthMonitor must catch,
+        # since no exit code will ever arrive
+        try:
+            obs.flush_sink()
+        except Exception:
+            pass
+        while True:
+            time.sleep(60.0)
     if name in POISON_POINTS:
         return True
     raise InjectedFault(name, step)

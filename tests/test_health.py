@@ -1,0 +1,440 @@
+"""Health & liveness layer (paddle_tpu/observability/health.py): the
+stall classifier (hung = fresh heartbeats + stalled step counter), the
+rotation-safe sink tail, the heartbeat emitter round trip, the serving
+SLO burn-rate monitor, InferenceServer.health(), and the supervisor's
+heartbeat watchdog (wait_gang terminating a hung/dead-but-running gang).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import InferenceServer, freeze_program
+from paddle_tpu.models import mnist
+from paddle_tpu.observability import health
+from paddle_tpu.observability.export import SinkTail, iter_events
+from paddle_tpu.observability.health import (
+    HEARTBEAT_EVENT,
+    HUNG_EXIT_CODE,
+    STATUS_ALIVE,
+    STATUS_DEAD,
+    STATUS_HUNG,
+    STATUS_STARTING,
+    HealthMonitor,
+    RankHealth,
+    SloMonitor,
+)
+from paddle_tpu.resilience.retrying import Backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _health_isolation():
+    """No heartbeat thread or step counter leaks across tests."""
+    health.stop_heartbeat()
+    health.reset_steps()
+    yield
+    health.stop_heartbeat()
+    health.reset_steps()
+    flags.reset_flag("heartbeat_ms")
+
+
+def _hb(ts_s, step, seq=1, host=0):
+    """A heartbeat event exactly as the sink stores it (ts in us)."""
+    return {"t": "span", "name": HEARTBEAT_EVENT, "ts": ts_s * 1e6,
+            "dur": 0.0, "host": host, "args": {"seq": seq, "step": step}}
+
+
+# ---------------------------------------------------------------------------
+# stall classifier
+# ---------------------------------------------------------------------------
+
+def test_classifier_hung_fresh_beats_stalled_steps():
+    """The defining signature: heartbeats keep arriving but the step
+    counter froze — alive until the stall passes the timeout, hung
+    after, never dead (the beats are fresh throughout)."""
+    rh = RankHealth(0, heartbeat_ms=1000.0)
+    t = 1000.0
+    for i in range(5):
+        rh.observe(_hb(t + i, step=i + 1, seq=i + 1))
+    # the counter stalls at step 5 while beats continue to t+29
+    for i in range(5, 30):
+        rh.observe(_hb(t + i, step=5, seq=i + 1))
+    assert rh.status(t + 5.0, hang_timeout_s=10.0) == STATUS_ALIVE
+    assert rh.status(t + 29.5, hang_timeout_s=10.0) == STATUS_HUNG
+
+
+def test_classifier_dead_when_beats_stop():
+    rh = RankHealth(1, heartbeat_ms=100.0)
+    started = 50.0
+    # never beat: starting through the grace window, dead past it
+    assert rh.status(started + 1.0, started_at=started) == STATUS_STARTING
+    assert rh.status(started + health.START_GRACE_S + 41.0,
+                     started_at=started) == STATUS_DEAD
+    rh.observe(_hb(started + 2.0, step=1))
+    assert rh.status(started + 2.5, started_at=started) == STATUS_ALIVE
+    # beats stop: dead once the silence passes the dead timeout
+    assert rh.status(started + 2.0 + rh.dead_timeout() + 1.0,
+                     started_at=started) == STATUS_DEAD
+
+
+def test_classifier_previous_incarnation_fenced():
+    """Heartbeats older than the monitor's started_at belong to a
+    previous life of the sink file and must not condemn (or vouch for)
+    the current process."""
+    rh = RankHealth(0, heartbeat_ms=100.0)
+    rh.observe(_hb(100.0, step=7))
+    started = 200.0
+    assert rh.status(started + 1.0, started_at=started) == STATUS_STARTING
+
+
+def test_classifier_ewma_derived_hang_timeout():
+    rh = RankHealth(0, heartbeat_ms=1000.0)
+    t = 5000.0
+    # 10 beats 1s apart, 2 steps per beat -> ~0.5 s/step EWMA
+    for i in range(10):
+        rh.observe(_hb(t + i, step=2 * i, seq=i + 1))
+    assert rh.ewma_step_s == pytest.approx(0.5, rel=0.05)
+    # auto timeout = max(20 x 0.5, 3 x 1.0) = 10s
+    assert rh.hang_timeout(0.0) == pytest.approx(10.0, rel=0.1)
+    # an explicit configured timeout wins exactly
+    assert rh.hang_timeout(3.0) == 3.0
+
+
+def test_classifier_restart_resets_stall_clock():
+    """A respawned worker's process-local counter restarts LOWER; any
+    change must count as an advance or the replay reads as a stall."""
+    rh = RankHealth(0, heartbeat_ms=1000.0)
+    rh.observe(_hb(100.0, step=50))
+    rh.observe(_hb(130.0, step=2, seq=2))   # restarted counter
+    assert rh.step_advance_ts == pytest.approx(130.0)
+    assert rh.status(132.0, hang_timeout_s=10.0) == STATUS_ALIVE
+
+
+def test_classifier_pre_ewma_default_covers_cold_compile():
+    """Before any step has completed there is no EWMA; the auto timeout
+    must fall back to the conservative compile-safe default."""
+    rh = RankHealth(0, heartbeat_ms=1000.0)
+    rh.observe(_hb(10.0, step=0))
+    assert rh.hang_timeout(0.0) >= health.DEFAULT_HANG_TIMEOUT_S
+
+
+# ---------------------------------------------------------------------------
+# rotation-safe tail (hoisted into export.py)
+# ---------------------------------------------------------------------------
+
+def test_sink_tail_survives_rotation(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    with open(p, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"t": "span", "name": "a", "n": i}) + "\n")
+    tail = SinkTail(p)
+    assert len(tail.poll()) == 5
+    # two more lines, then the live file rotates away and a fresh live
+    # file gets one line: the next poll must yield exactly the 3 unseen
+    with open(p, "a") as f:
+        for i in range(5, 7):
+            f.write(json.dumps({"t": "span", "name": "a", "n": i}) + "\n")
+    os.replace(p, p + ".1")
+    with open(p, "w") as f:
+        f.write(json.dumps({"t": "span", "name": "a", "n": 7}) + "\n")
+    got = [ev["n"] for ev in tail.poll()]
+    assert got == [5, 6, 7]
+
+
+def test_health_monitor_tails_and_classifies(tmp_path):
+    sink = str(tmp_path / "hb.h0.jsonl")
+    mon = HealthMonitor({0: sink}, heartbeat_ms=100.0, hang_timeout_s=5.0,
+                        started_at=0.0, poll_min_interval_s=0.0)
+    now = time.time()
+    with open(sink, "w") as f:
+        for i in range(4):
+            f.write(json.dumps(_hb(now - 0.3 + 0.1 * i, step=i + 1,
+                                   seq=i + 1)) + "\n")
+    assert mon.poll(force=True) == 4
+    assert mon.classify(now=now) == {0: STATUS_ALIVE}
+    assert mon.unhealthy(now=now) == {}
+    # the same rank, much later, with nothing new in the sink: dead
+    assert mon.unhealthy(now=now + 60.0) == {0: STATUS_DEAD}
+    # only live ranks are consulted
+    assert mon.unhealthy(now=now + 60.0, ranks=[]) == {}
+    assert mon.classify_wall_s >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# heartbeat emitter
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_round_trip_through_sink(tmp_path):
+    sink = str(tmp_path / "beat.jsonl")
+    obs.attach_sink(sink, host=0)
+    try:
+        em = health.HeartbeatEmitter(interval_ms=30.0).start()
+        for _ in range(3):
+            health.note_step()
+        time.sleep(0.35)
+        em.stop()
+    finally:
+        s = obs.detach_sink()
+    beats = []
+    for path in (s.files() if s is not None else [sink]):
+        for ev in iter_events(path):
+            if ev.get("name") == HEARTBEAT_EVENT:
+                beats.append(ev)
+    assert len(beats) >= 3, "expected >=3 beats in 0.35s at 30ms"
+    seqs = [ev["args"]["seq"] for ev in beats]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert all("phase" in ev["args"] for ev in beats)
+    assert beats[-1]["args"]["step"] == 3
+
+
+def test_heartbeat_bypasses_metrics_gate(tmp_path):
+    """Liveness is not optional telemetry: beats flow to the sink even
+    with PADDLE_TPU_METRICS down."""
+    obs.set_enabled(False)
+    sink = str(tmp_path / "gated.jsonl")
+    obs.attach_sink(sink)
+    try:
+        em = health.HeartbeatEmitter(interval_ms=1000.0)
+        payload = em.emit_now()
+    finally:
+        obs.detach_sink()
+    assert payload["seq"] == 1
+    with open(sink) as f:
+        names = [json.loads(ln).get("name") for ln in f]
+    assert HEARTBEAT_EVENT in names
+
+
+def test_heartbeat_flag_autostart_and_stop():
+    assert health.heartbeat_emitter() is None
+    flags.set_flags({"heartbeat_ms": 25.0})
+    em = health.heartbeat_emitter()
+    assert em is not None and em.running
+    assert em.interval_ms == 25.0
+    flags.reset_flag("heartbeat_ms")
+    assert health.heartbeat_emitter() is None
+
+
+def test_heartbeat_payload_carries_phase():
+    obs.set_enabled(True)
+    with obs.span("train"):
+        with obs.span("step"):
+            p = health.HeartbeatEmitter(interval_ms=1000.0).emit_now()
+    assert p["phase"] == "step"
+    p2 = health.HeartbeatEmitter(interval_ms=1000.0).emit_now()
+    assert p2["phase"] == "idle"
+
+
+# ---------------------------------------------------------------------------
+# serving SLO burn-rate monitor
+# ---------------------------------------------------------------------------
+
+def test_slo_monitor_burns_and_recovers():
+    m = SloMonitor(slo_ms=10.0, target=0.999)
+    for i in range(20):
+        m.record(1.0, now=float(i) * 0.1)
+    assert not m.burning(now=2.0)
+    # hard violation burst: every request blows the SLO
+    for i in range(20):
+        m.record(100.0, now=3.0 + i * 0.1)
+    assert m.burning(now=5.0)
+    snap = m.snapshot(now=5.0)
+    assert snap["burning"] and snap["violations"] == 20
+    assert snap["p99_ms"] == pytest.approx(100.0)
+    # the burst ages out of the fast window with no new traffic: the
+    # live recompute reads recovered
+    assert not m.burning(now=5.0 + m.fast_window_s + 60.0 + 600.0)
+
+
+def test_slo_monitor_edge_events():
+    obs.set_enabled(True)
+    obs.reset()
+    m = SloMonitor(slo_ms=10.0, target=0.999, name="probe")
+    for i in range(10):
+        m.record(100.0, now=1.0 + i * 0.01)
+    assert obs.registry.counter_value("health.slo_burn") == 1
+    # still burning: no re-fire (edge-, not level-triggered)
+    m.record(100.0, now=2.0)
+    assert obs.registry.counter_value("health.slo_burn") == 1
+
+
+def test_slo_monitor_prunes_to_slow_window():
+    m = SloMonitor(slo_ms=10.0, slow_window_s=10.0)
+    for i in range(100):
+        m.record(1.0, now=float(i))
+    assert len(m._samples) <= 11
+
+
+# ---------------------------------------------------------------------------
+# InferenceServer.health()
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    main, startup, h = mnist.get_model(lr=0.01)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    frozen, _ = freeze_program(main, ["img"], [h["logits"].name],
+                               scope=scope)
+    return {"program": frozen, "feed_names": ["img"],
+            "fetch_names": [h["logits"].name], "scope": scope,
+            "exe": exe}
+
+
+def _server(served, **kw):
+    kw.setdefault("buckets", (1, 2, 4))
+    kw.setdefault("max_wait_ms", 10.0)
+    return InferenceServer(
+        served["program"], served["feed_names"], served["fetch_names"],
+        scope=served["scope"], executor=served["exe"], **kw)
+
+
+def _mk(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"img": rng.randn(n, 784).astype(np.float32)}
+
+
+def test_server_health_idle_and_stopped(served):
+    srv = _server(served, slo_ms=1000.0)
+    with srv:
+        h = srv.health()
+        assert h["healthy"] and h["worker_alive"]
+        assert h["queue_depth"] == 0
+        assert h["slo"]["requests"] == 0
+    h = srv.health()
+    assert not h["healthy"] and not h["worker_alive"]
+
+
+def test_server_health_flips_under_slo_burn(served):
+    """An SLO no request can meet: serving a handful of requests must
+    burn both windows and flip the readiness probe."""
+    srv = _server(served, slo_ms=0.001)
+    with srv:
+        srv.warmup(_mk(1))
+        assert srv.health()["healthy"]          # no traffic yet
+        for i in range(10):
+            srv.run(_mk(1, seed=i))
+        h = srv.health()
+    assert h["slo"]["burning"]
+    assert not h["healthy"]
+    assert h["p99_ms"] is not None and h["p99_ms"] > 0.001
+    assert h["last_dispatch_age_s"] is not None
+
+
+def test_server_health_no_slo_configured(served):
+    srv = _server(served)      # serving_slo_ms flag defaults to 0 = off
+    assert srv.slo is None
+    with srv:
+        srv.warmup(_mk(1))
+        srv.run(_mk(1))
+        h = srv.health()
+    assert "slo" not in h and h["queue_depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# supervisor watchdog (real subprocesses, no jax in workers)
+# ---------------------------------------------------------------------------
+
+_HANG_WORKER = r"""
+import json, os, sys, time
+sink = os.environ["PADDLE_TPU_METRICS_SINK"]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+mode = %r
+if mode == "succeed_after_restart" and \
+        os.environ.get("PADDLE_TPU_RESTART_COUNT", "0") != "0":
+    sys.exit(0)
+with open(sink, "a") as f:
+    i = 0
+    deadline = time.time() + (3.0 if mode == "go_quiet" else 120.0)
+    while time.time() < deadline or mode != "go_quiet":
+        i += 1
+        f.write(json.dumps({"t": "span", "name": "health.heartbeat",
+                            "ts": time.time() * 1e6, "dur": 0.0,
+                            "host": rank,
+                            "args": {"seq": i, "step": 3}}) + "\n")
+        f.flush()
+        time.sleep(0.05)
+        if mode == "go_quiet" and i >= 5:
+            break
+# beats stopped but the process lives on: only the watchdog can end it
+time.sleep(300)
+"""
+
+
+def _supervise_hang(tmp_path, mode, max_restarts=0, port=6510):
+    from paddle_tpu.distributed.launch import supervise
+
+    sink = str(tmp_path / "metrics.jsonl")
+    return supervise(
+        ["-c", _HANG_WORKER % mode], nproc=2, max_restarts=max_restarts,
+        started_port=port,
+        env_extra={"PADDLE_TPU_METRICS_SINK": sink},
+        backoff=Backoff(base=0.01, jitter=0.0),
+        heartbeat_ms=100.0, hang_timeout_s=1.5)
+
+
+def test_wait_gang_detects_hung_rank(tmp_path):
+    """Both ranks beat forever with a frozen step counter: the monitor
+    must classify them hung and wait_gang must return HUNG_EXIT_CODE
+    instead of blocking on processes that will never exit."""
+    t0 = time.monotonic()
+    rc = _supervise_hang(tmp_path, "hang_forever", port=6510)
+    took = time.monotonic() - t0
+    assert rc == HUNG_EXIT_CODE
+    assert took < 60, "watchdog took %.0fs" % took
+
+
+def test_wait_gang_detects_dead_rank(tmp_path):
+    """A rank whose beats STOP (process still running) reads dead once
+    the silence passes the dead timeout."""
+    t0 = time.monotonic()
+    rc = _supervise_hang(tmp_path, "go_quiet", port=6520)
+    took = time.monotonic() - t0
+    assert rc == HUNG_EXIT_CODE
+    assert took < 60, "watchdog took %.0fs" % took
+
+
+def test_supervise_restarts_hung_gang(tmp_path):
+    """A hang in incarnation 0 consumes one restart; incarnation 1
+    exits 0 — the watchdog feeds the same restart machinery an exit
+    code does."""
+    rc = _supervise_hang(tmp_path, "succeed_after_restart",
+                         max_restarts=1, port=6530)
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos: injected worker_hang under the supervised launcher
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_run_worker_hang_bit_exact(tmp_path):
+    """The acceptance bar: a 2-worker run with rank 1 wedging at step 8
+    completes with bit-exact loss parity vs the fault-free run, and the
+    hang is DETECTED from heartbeat data (health.hang_detected in the
+    telemetry), not from an exit code (none ever arrives)."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+           "--workdir", str(tmp_path), "--nproc", "2", "--steps", "14",
+           "--spec", "worker_hang@rank1:step8", "--max-restarts", "2",
+           "--started_port", "6541"]
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    env["PADDLE_TPU_MAX_RESTARTS"] = "0"
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                         env=env)
+    assert out.returncode == 0, (out.stdout, out.stderr[-3000:])
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["ok"], verdict
+    assert verdict["restarts"] >= 1
+    assert "health.hang_detected" in verdict["recovery_events"], verdict

@@ -22,6 +22,7 @@ Usage::
 
     python tools/chaos_run.py --steps 30 --nproc 2 --seed 7
     python tools/chaos_run.py --spec 'step_nan@9' --nproc 1
+    python tools/chaos_run.py --hang --nproc 2        # heartbeat watchdog
 
 CPU-only by construction (workers force JAX_PLATFORMS=cpu); the point
 is recovery-path coverage, not throughput.
@@ -173,17 +174,23 @@ def run_supervisor(args):
     from paddle_tpu.resilience.faultinject import random_spec
 
     flags.set_flags({"metrics": True})
+    kinds = (("worker_hang", "step_nan") if args.hang
+             else ("worker_kill", "step_nan"))
     spec = args.spec if args.spec is not None else random_spec(
-        args.seed, args.steps, nproc=args.nproc)
+        args.seed, args.steps, nproc=args.nproc, kinds=kinds)
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_run_")
     result_dir = os.path.join(workdir, "results")
     ckpt_dir = os.path.join(workdir, "ckpt")
     os.makedirs(result_dir, exist_ok=True)
     sink = os.path.join(workdir, "metrics.jsonl")
-    # kills count against the restart budget; everything else the
-    # workers absorb in-process
+    # the supervisor's own events (health.hang_detected, recovery.*)
+    # land in the same sink family as the workers', host-tagged h99
+    obs.attach_sink(sink, host=99)
+    # kills AND watchdog-cleared hangs count against the restart budget;
+    # everything else the workers absorb in-process
     max_restarts = args.max_restarts if args.max_restarts is not None \
-        else max(2, spec.count("worker_kill") + 1)
+        else max(2, spec.count("worker_kill")
+                 + spec.count("worker_hang") + 1)
     env_extra = {
         "PADDLE_TPU_FAULT_SPEC": spec,
         "PADDLE_TPU_METRICS": "1",
@@ -202,7 +209,10 @@ def run_supervisor(args):
         worker_cmd.append("--mesh")
     rc = supervise(worker_cmd, nproc=args.nproc, env_extra=env_extra,
                    max_restarts=max_restarts, recovery_dir=ckpt_dir,
-                   started_port=args.started_port)
+                   started_port=args.started_port,
+                   heartbeat_ms=args.heartbeat_ms,
+                   hang_timeout_s=args.hang_timeout)
+    obs.detach_sink()
 
     verdict = {"spec": spec, "rc": rc, "workdir": workdir,
                "restarts": obs.snapshot()["counters"].get(
@@ -234,11 +244,17 @@ def run_supervisor(args):
                 except ValueError:
                     continue
                 if str(ev.get("name", "")).startswith(
-                        ("recovery.", "faultinject")):
+                        ("recovery.", "faultinject", "health.")):
                     recoveries.append(ev.get("name"))
     verdict["recovery_events"] = sorted(set(recoveries))
     if spec and not recoveries and verdict["restarts"] == 0:
         problems.append("no recovery events recorded for spec %r" % spec)
+    if "worker_hang" in spec and \
+            "health.hang_detected" not in verdict["recovery_events"]:
+        # the acceptance bar: the hang must be DETECTED from heartbeat
+        # data, not merely survived by accident
+        problems.append("spec injected worker_hang but the supervisor "
+                        "never recorded health.hang_detected")
     if args.check_parity and not problems:
         import numpy as np
 
@@ -275,7 +291,19 @@ def main():
     parser.add_argument("--spec", default=None,
                         help="explicit fault spec; overrides --seed")
     parser.add_argument("--max-restarts", type=int, default=None,
-                        help="default: worker kills in the spec + 1")
+                        help="default: worker kills/hangs in the spec + 1")
+    parser.add_argument("--hang", action="store_true",
+                        help="seeded spec injects worker_hang instead of "
+                             "worker_kill — exercises the heartbeat "
+                             "watchdog rather than the exit-code path")
+    parser.add_argument("--heartbeat-ms", type=float, default=200.0,
+                        help="worker heartbeat interval under supervise")
+    parser.add_argument("--hang-timeout", type=float, default=15.0,
+                        help="seconds of step-counter stall before the "
+                             "supervisor declares a rank hung (must "
+                             "comfortably exceed worker startup + first "
+                             "XLA compile, which the stall clock ticks "
+                             "through)")
     parser.add_argument("--workdir", default=None,
                         help="default: fresh temp dir, kept for forensics")
     parser.add_argument("--result-dir", default=None)

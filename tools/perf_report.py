@@ -18,8 +18,8 @@ sinks each worker streamed (``PADDLE_TPU_METRICS_SINK`` +
 distributed/launch.py's per-rank tagging — ``<base>.h<rank>.jsonl``
 plus rotations). The merge joins them on step number into the table a
 pod run is debugged from: per-step latency skew across workers,
-slowest-worker attribution, and each worker's aggregate HBM
-watermarks.
+slowest-worker attribution, per-worker heartbeat ages (which rank went
+quiet or stalled first), and each worker's aggregate HBM watermarks.
 
 Usage:
     PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \\
@@ -110,14 +110,21 @@ def load_worker_dumps(dump_dir):
     """Parse every JSONL sink file under ``dump_dir`` (live + rotated),
     grouped by the host id each event carries:
     ``{host: {"steps": {step: dur_ms}, "hbm": {gauge: max_bytes},
-    "files": [...], "events": n}}``."""
+    "hb": {count, last_ts, last_step, step_ts}, "files": [...],
+    "events": n, "last_ts": newest_event_us}}``. The ``hb`` record
+    tracks the newest ``health.heartbeat`` per worker so the merged
+    report can show which rank went quiet (or stalled) first."""
     from paddle_tpu.observability.export import iter_events, sink_file_set
+    from paddle_tpu.observability.health import HEARTBEAT_EVENT
 
     workers = {}
 
     def w(host):
         return workers.setdefault(
-            host, {"steps": {}, "hbm": {}, "files": set(), "events": 0})
+            host, {"steps": {}, "hbm": {},
+                   "hb": {"count": 0, "last_ts": None, "last_step": None,
+                          "step_ts": None},
+                   "files": set(), "events": 0, "last_ts": None})
 
     for path in sink_file_set(dump_dir):
         for ev in iter_events(path):
@@ -125,6 +132,10 @@ def load_worker_dumps(dump_dir):
             rec = w(host)
             rec["files"].add(os.path.basename(path))
             rec["events"] += 1
+            ts = ev.get("ts")
+            if ts is not None:
+                rec["last_ts"] = ts if rec["last_ts"] is None \
+                    else max(rec["last_ts"], ts)
             kind = ev.get("t")
             if kind == "span" and ev.get("name") == "step":
                 step = (ev.get("args") or {}).get("step")
@@ -132,6 +143,16 @@ def load_worker_dumps(dump_dir):
                     # keep the LAST duration per step number (restarted
                     # counters: later wins, matching the file order)
                     rec["steps"][int(step)] = ev.get("dur", 0.0) / 1e3
+            elif kind == "span" and ev.get("name") == HEARTBEAT_EVENT:
+                hb = rec["hb"]
+                hb["count"] += 1
+                if ts is not None and (hb["last_ts"] is None
+                                       or ts >= hb["last_ts"]):
+                    hb["last_ts"] = ts
+                    step = (ev.get("args") or {}).get("step")
+                    if step is not None and step != hb["last_step"]:
+                        hb["last_step"] = step
+                        hb["step_ts"] = ts
             elif kind == "snap":
                 gauges = (ev.get("metrics") or {}).get("gauges") or {}
                 for g in HBM_GAUGES:
@@ -156,7 +177,7 @@ def _fmt_bytes(n):
 
 def render_merge(workers):
     """The cross-host report: step-skew table, slowest-worker
-    attribution, aggregate HBM watermarks."""
+    attribution, worker heartbeat health, aggregate HBM watermarks."""
     hosts = sorted(workers)
     lines = ["== cross-host: per-step wall (ms) across %d worker(s) =="
              % len(hosts)]
@@ -199,6 +220,31 @@ def render_merge(workers):
             for h in hosts if slowest_count[h])
         if attribution:
             lines.append("slowest-worker attribution: " + attribution)
+    if any(workers[h]["hb"]["count"] for h in hosts):
+        # heartbeat ages are measured against the FLEET's newest event:
+        # in a post-mortem dump "now" is whenever the job died, and the
+        # rank whose age stands out is the one that went quiet first
+        fleet_end = max(workers[h]["last_ts"] for h in hosts
+                        if workers[h]["last_ts"] is not None)
+        lines.append("")
+        lines.append("== worker health (heartbeat ages vs fleet end) ==")
+        hdr = ("host", "beats", "last_step", "hb_age_s", "stalled_s")
+        lines.append("  ".join("%10s" % c for c in hdr))
+        for h in hosts:
+            hb = workers[h]["hb"]
+            age = (fleet_end - hb["last_ts"]) / 1e6 \
+                if hb["last_ts"] is not None else None
+            stalled = (hb["last_ts"] - hb["step_ts"]) / 1e6 \
+                if hb["last_ts"] is not None and hb["step_ts"] is not None \
+                else None
+            lines.append("  ".join([
+                "%10s" % ("h%s" % h),
+                "%10d" % hb["count"],
+                "%10s" % (hb["last_step"]
+                          if hb["last_step"] is not None else "-"),
+                "%10s" % ("%.1f" % age if age is not None else "-"),
+                "%10s" % ("%.1f" % stalled
+                          if stalled is not None else "-")]))
     lines.append("")
     lines.append("== aggregate HBM watermarks ==")
     short = {g: g[len("hbm."):] for g in HBM_GAUGES}

@@ -28,6 +28,7 @@ Usage:
   python tools/serve_probe.py --model mlp --qps 5,10,20
   python tools/serve_probe.py --model resnet50 --no-int8 --duration 3
   python tools/serve_probe.py --qps 4,8 --slo-ms 100 --slo-floor-qps 4
+  python tools/serve_probe.py --qps 8 --check-health   # readiness flip
 """
 
 import argparse
@@ -91,7 +92,7 @@ def _build(model, seed):
 
 
 def build_server(model="mlp", int8=True, calib_batches=4, buckets=None,
-                 max_wait_ms=None, seed=0):
+                 max_wait_ms=None, seed=0, slo_ms=None):
     """Freeze (+quantize) the model and wrap it in an InferenceServer
     (not yet started). Returns (server, one_row_fn, build_info)."""
     import numpy as np
@@ -127,7 +128,8 @@ def build_server(model="mlp", int8=True, calib_batches=4, buckets=None,
         info["skipped_ops"] = len(qrep.skipped)
     server = InferenceServer(program, feed_names, fetch_names, scope=scope,
                              executor=exe, buckets=buckets,
-                             max_wait_ms=max_wait_ms, name="probe")
+                             max_wait_ms=max_wait_ms, name="probe",
+                             slo_ms=slo_ms)
     return server, one_row, info
 
 
@@ -173,9 +175,11 @@ def _read_sink_serving(path):
 
 
 def probe_serving(server, one_row, qps_levels, duration=2.0, seed=0,
-                  sink_dir=None):
+                  sink_dir=None, health_log=None):
     """Run the sweep; returns a list of per-level dicts (scored from the
-    telemetry sinks)."""
+    telemetry sinks). Each row also carries the ``server.health()``
+    readiness snapshot taken right after its level; when ``health_log``
+    is a list, the pre-load baseline snapshot is appended to it."""
     import numpy as np
 
     from paddle_tpu import observability as obs
@@ -186,6 +190,8 @@ def probe_serving(server, one_row, qps_levels, duration=2.0, seed=0,
     rows = []
     with server:
         server.warmup(one_row())
+        if health_log is not None:
+            health_log.append(server.health())
         for qps in qps_levels:
             sink = os.path.join(sink_dir, "serve_qps%g.jsonl" % qps)
             obs.reset()
@@ -193,6 +199,9 @@ def probe_serving(server, one_row, qps_levels, duration=2.0, seed=0,
             rng = np.random.RandomState(seed)
             n, elapsed = _poisson_level(server, one_row, qps, duration,
                                         rng)
+            # readiness snapshot BEFORE leaving the context: health()
+            # needs the worker thread alive to mean anything
+            health = server.health()
             obs.detach_sink()
             m = _read_sink_serving(sink) or {"histograms": {},
                                              "counters": {}}
@@ -209,6 +218,7 @@ def probe_serving(server, one_row, qps_levels, duration=2.0, seed=0,
                 "p99_ms": req.get("p99"),
                 "queue_depth_mean": depth.get("mean"),
                 "batch_fill_mean": fill.get("mean"),
+                "health": health,
             })
     obs.set_enabled(None)
     return rows
@@ -265,24 +275,55 @@ def main(argv=None):
     ap.add_argument("--slo-floor-qps", type=float, default=0.0,
                     help="exit 1 if the best QPS meeting --slo-ms is "
                          "below this")
+    ap.add_argument("--serving-slo-ms", type=float, default=None,
+                    help="server-side SLO fed to the burn-rate monitor "
+                         "(InferenceServer slo_ms) — health() flips "
+                         "unhealthy when the sweep burns its budget")
+    ap.add_argument("--check-health", action="store_true",
+                    help="assert the readiness probe works: healthy "
+                         "before load, unhealthy (burning) under an "
+                         "SLO the sweep cannot meet (default "
+                         "--serving-slo-ms 0.05)")
     args = ap.parse_args(argv)
+    if args.check_health and args.serving_slo_ms is None:
+        # an SLO so tight every served request violates it: the sweep
+        # load IS the injected burn
+        args.serving_slo_ms = 0.05
 
     qps_levels = [float(q) for q in args.qps.split(",") if q.strip()]
     server, one_row, info = build_server(
         args.model, int8=args.int8, calib_batches=args.calib_batches,
         buckets=args.buckets, max_wait_ms=args.max_wait_ms,
-        seed=args.seed)
+        seed=args.seed, slo_ms=args.serving_slo_ms)
     print("== %s (%s) ==" % (args.model,
                              "int8" if args.int8 else "fp32"))
     if "quantized_ops" in info:
         print("quantized %d op(s), skipped %d" % (
             info["quantized_ops"], info["skipped_ops"]))
+    health_log = []
     rows = probe_serving(server, one_row, qps_levels,
                          duration=args.duration, seed=args.seed,
-                         sink_dir=args.sink_dir)
+                         sink_dir=args.sink_dir, health_log=health_log)
     print(render_table(rows))
     summary = {"model": args.model, "int8": args.int8, "levels": rows}
     print(json.dumps(summary))
+    if args.check_health:
+        baseline = health_log[0] if health_log else None
+        flipped = [r["qps_offered"] for r in rows
+                   if r.get("health") and not r["health"]["healthy"]]
+        verdict = {
+            "serving_slo_ms": args.serving_slo_ms,
+            "baseline_healthy": bool(baseline and baseline["healthy"]),
+            "flipped_unhealthy_at_qps": flipped,
+            "ok": bool(baseline and baseline["healthy"] and flipped),
+        }
+        print("health check: " + json.dumps(verdict))
+        if not verdict["ok"]:
+            sys.stderr.write(
+                "serving health check failed: expected healthy() before "
+                "load and an unhealthy burn under slo_ms=%s\n"
+                % args.serving_slo_ms)
+            return 1
     if args.slo_ms is not None:
         best, ok = slo_gate(rows, args.slo_ms, args.slo_floor_qps)
         print("slo: best qps with p99<=%.1fms: %.2f (floor %.1f)"

@@ -19,7 +19,6 @@ drains the freshly rotated segment before following the new live file.
 """
 import argparse
 import collections
-import json
 import os
 import sys
 import time
@@ -27,80 +26,17 @@ import time
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), os.pardir)))
 
+from paddle_tpu.observability.export import SinkTail  # noqa: E402,F401
+from paddle_tpu.observability.health import (  # noqa: E402
+    HEARTBEAT_EVENT,
+    RankHealth,
+)
 from paddle_tpu.observability.metrics import snapshot_text  # noqa: E402
 
 # Step spans kept for the rate/latency window.
 STEP_WINDOW = 512
 # Step-rate lookback (seconds of span timestamps).
 RATE_WINDOW_S = 60.0
-
-
-class SinkTail:
-    """Incremental reader of a live JSONL sink file. Yields complete
-    events only (a torn final line is retried on the next poll) and
-    survives size-based rotation: a shrink means the content moved to
-    ``<path>.<seq>`` — the unread tail of the newest rotation is
-    drained first, then the new live file from offset 0."""
-
-    def __init__(self, path):
-        self.path = path
-        self.offset = 0
-        self._carry = ""
-
-    def _read_from(self, path, offset):
-        try:
-            with open(path, encoding="utf-8") as f:
-                f.seek(offset)
-                data = f.read()
-        except OSError:
-            return "", offset
-        return data, offset + len(data)
-
-    def _newest_rotation(self):
-        d = os.path.dirname(os.path.abspath(self.path)) or "."
-        base = os.path.basename(self.path) + "."
-        best, best_seq = None, -1
-        try:
-            names = os.listdir(d)
-        except OSError:
-            return None
-        for name in names:
-            if name.startswith(base) and name[len(base):].isdigit():
-                seq = int(name[len(base):])
-                if seq > best_seq:
-                    best, best_seq = os.path.join(d, name), seq
-        return best
-
-    def poll(self):
-        """-> list of new event dicts since the last poll."""
-        try:
-            size = os.path.getsize(self.path)
-        except OSError:
-            size = 0
-        chunks = []
-        if size < self.offset:
-            # rotated away: drain what we had not read from the segment
-            # that now lives under the newest rotation suffix
-            rotated = self._newest_rotation()
-            if rotated:
-                data, _ = self._read_from(rotated, self.offset)
-                chunks.append(data)
-            self.offset = 0
-        data, self.offset = self._read_from(self.path, self.offset)
-        chunks.append(data)
-        text = self._carry + "".join(chunks)
-        lines = text.split("\n")
-        self._carry = lines.pop()  # "" on a complete final line
-        events = []
-        for line in lines:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except ValueError:
-                continue
-        return events
 
 
 class TopState:
@@ -115,6 +51,7 @@ class TopState:
         self.last_snap = None
         self.last_snap_ts = None
         self.last_nan_inf = None
+        self.ranks = {}  # host id -> RankHealth (heartbeat liveness)
 
     def consume(self, ev):
         self.events += 1
@@ -130,6 +67,14 @@ class TopState:
                 self.total_steps += 1
             elif name == "nan_inf_trip":
                 self.last_nan_inf = ev
+            elif name == HEARTBEAT_EVENT:
+                host = ev.get("host", 0)
+                rh = self.ranks.get(host)
+                if rh is None:
+                    interval = (ev.get("args") or {}).get("interval_ms")
+                    rh = self.ranks[host] = RankHealth(
+                        host, heartbeat_ms=interval)
+                rh.observe(ev)
         elif kind == "snap":
             self.last_snap = ev.get("metrics") or {}
             self.last_snap_ts = ev.get("ts")
@@ -223,6 +168,23 @@ def render(state, path, metrics_lines=12, now_us=None):
                               args.get("inf", 0), age_s))
     else:
         lines.append("nan/inf: none")
+
+    if state.ranks:
+        now_s = now_us / 1e6
+        parts = []
+        for host in sorted(state.ranks):
+            rh = state.ranks[host]
+            status = rh.status(now_s)
+            age = (now_s - rh.last_hb_ts
+                   if rh.last_hb_ts is not None else None)
+            parts.append("h%s %s (step %s, hb %s ago)"
+                         % (host, status.upper(),
+                            rh.last_step if rh.last_step is not None else "-",
+                            "%.1fs" % age if age is not None else "-"))
+        lines.append("health: " + "   ".join(parts))
+    else:
+        lines.append("health: (no heartbeats yet — set "
+                     "PADDLE_TPU_HEARTBEAT_MS)")
 
     if state.last_snap and metrics_lines > 0:
         lines.append("")
