@@ -102,27 +102,45 @@ def _slice_index(shard, global_shape):
 class CheckpointManager:
     """Background-thread checkpoint writer with atomic publication.
 
-    save() captures array references and returns immediately; the
-    transfer + write happens on a daemon thread. A checkpoint directory
-    appears under its final name only when complete (write to
-    ``.tmp_step_N``, fsync, ``os.rename``) — a crash mid-save can never
-    publish a half checkpoint, the property the reference gets from
-    writing params into place one save op at a time and loses on crash.
+    save() captures an off-critical-path snapshot and returns
+    immediately; the device->host transfer + file writes happen on ONE
+    persistent daemon writer thread consuming a bounded pending queue —
+    so the step thread never joins the PREVIOUS save either (the PR 5
+    design joined it inside save(); that join was the residual
+    checkpoint wall this completes the removal of). The snapshot cost
+    the step thread still pays is dispatching one on-device copy per
+    var (~ms; must happen before the next step's donation invalidates
+    the source buffers) plus kicking off the D2H transfer with
+    ``copy_to_host_async`` so it overlaps training instead of starting
+    when the writer gets around to ``np.asarray``.
+
+    A checkpoint directory appears under its final name only when
+    complete (write to ``.tmp_step_N``, fsync, ``os.rename``) — a crash
+    mid-save can never publish a half checkpoint, the property the
+    reference gets from writing params into place one save op at a time
+    and loses on crash. The single writer publishes saves in submission
+    order. ``max_pending`` bounds snapshot memory: a checkpoint interval
+    shorter than the write time degrades toward synchronous saving
+    (save() blocks until the queue drains below the bound) rather than
+    piling up device snapshots.
     """
 
     def __init__(self, root, max_to_keep=3, process_index=None,
-                 process_count=None):
+                 process_count=None, max_pending=2):
         self.root = root
         self.max_to_keep = max_to_keep
+        self.max_pending = max(1, int(max_pending))
         # process identity resolves LAZILY at first save: querying jax
         # here would initialize the backend, poisoning a later
         # jax.distributed.initialize() when the manager is constructed
         # first (the natural script order)
         self._proc = (process_index, process_count)
         os.makedirs(root, exist_ok=True)
-        self._thread = None
         self._error = None
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._pending = []      # [(step, snapshot)] consumed in order
+        self._writing = False
+        self._writer = None     # the persistent daemon thread
 
     def _resolve_proc(self):
         pi, pc = self._proc
@@ -155,34 +173,77 @@ class CheckpointManager:
 
     # -- save --------------------------------------------------------------
     def save(self, step, arrays, blocking=False):
-        """``arrays``: {name: array-like}. Captures a snapshot now, writes
-        in the background. One save is in flight at a time: if the
-        PREVIOUS save is still writing, this call first joins it (so a
-        checkpoint interval shorter than the write time degrades to
-        synchronous saving rather than piling up threads). Raises any
-        previous save's error (like orbax: a failed async save surfaces
-        on the next interaction)."""
+        """``arrays``: {name: array-like}. Captures a snapshot now (an
+        async on-device copy per jax array + an async D2H kickoff — the
+        step thread's only cost), enqueues it for the persistent writer
+        thread, and returns without joining any in-flight write. Raises
+        any previous save's error (like orbax: a failed async save
+        surfaces on the next interaction). A full pending queue
+        (``max_pending``) blocks until the writer drains — bounded
+        memory over unbounded pile-up."""
+        import time as _time
+
+        from paddle_tpu import observability as obs
+
         self.check_error()
-        self.wait()                      # one in-flight save at a time
+        t0 = _time.perf_counter()
         snapshot = {}
         for name, arr in arrays.items():
-            # jax arrays: async on-device copy (the original may be a
-            # DONATED buffer the next training step deletes); host
-            # values: reference capture
-            snapshot[name] = (arr.copy()
-                              if hasattr(arr, "addressable_shards")
-                              else arr)
-        t = threading.Thread(
-            target=self._write, args=(int(step), snapshot), daemon=True)
-        with self._lock:
-            self._thread = t
-        t.start()
+            if hasattr(arr, "addressable_shards"):
+                # jax array: async on-device copy (the original may be
+                # a DONATED buffer the next training step deletes), then
+                # start the device->host transfer NOW so it overlaps
+                # training instead of the writer's np.asarray paying it
+                cp = arr.copy()
+                try:
+                    cp.copy_to_host_async()
+                except Exception:      # backend-dependent; best-effort
+                    pass
+                snapshot[name] = cp
+            else:
+                # host values: reference capture (nothing mutates them —
+                # scope.set rebinds)
+                snapshot[name] = arr
+        obs.observe("ckpt.snapshot_ms",
+                    (_time.perf_counter() - t0) * 1000.0)
+        with self._cv:
+            self._ensure_writer()
+            self._pending.append((int(step), snapshot))
+            obs.set_gauge("ckpt.pending", len(self._pending))
+            self._cv.notify_all()
+            while len(self._pending) > self.max_pending:
+                obs.inc("ckpt.backpressure_waits")
+                self._cv.wait()
         if blocking:
             self.wait()
             self.check_error()
 
+    def _ensure_writer(self):
+        """Start (or restart, should it ever die) the persistent writer
+        under self._cv."""
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="paddle-tpu-ckpt-writer",
+                daemon=True)
+            self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            with self._cv:
+                while not self._pending:
+                    self._cv.wait()
+                step, snapshot = self._pending.pop(0)
+                self._writing = True
+                self._cv.notify_all()
+            try:
+                self._write(step, snapshot)
+            finally:
+                with self._cv:
+                    self._writing = False
+                    self._cv.notify_all()
+
     def _write(self, step, snapshot):
-        """Background-thread entry: the write attempt runs under the
+        """Writer-thread entry: the write attempt runs under the
         shared retry policy (resilience.retrying) so transient
         filesystem errors — or an injected ckpt_write fault — cost a
         backoff-spaced re-attempt, not the checkpoint. Each attempt
@@ -315,10 +376,11 @@ class CheckpointManager:
 
     # -- lifecycle ---------------------------------------------------------
     def wait(self):
-        with self._lock:
-            t = self._thread
-        if t is not None:
-            t.join()
+        """Block until every enqueued save has been written (the
+        ResilientDriver's join-the-snapshot rollback seam)."""
+        with self._cv:
+            while self._pending or self._writing:
+                self._cv.wait()
 
     def check_error(self):
         if self._error is not None:
@@ -327,9 +389,8 @@ class CheckpointManager:
 
     @property
     def in_flight(self):
-        with self._lock:
-            t = self._thread
-        return t is not None and t.is_alive()
+        with self._cv:
+            return bool(self._pending or self._writing)
 
     # -- restore -----------------------------------------------------------
     def _step_dirs(self, step=None):

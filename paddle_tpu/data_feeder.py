@@ -122,11 +122,18 @@ class DataFeeder:
                        for k, v in fd.items()}
 
     def decorate_reader(self, reader, multi_devices=False,
-                        num_places=None, drop_last=True):
+                        num_places=None, drop_last=True,
+                        prefetch=False, prefetch_depth=None):
         """Wrap a batch reader into one yielding feed dicts (reference:
         data_feeder.py decorate_reader). With ``multi_devices`` and
         ``drop_last``, trailing chunks smaller than the per-place size
-        are dropped so every device sees uniform batch shapes."""
+        are dropped so every device sees uniform batch shapes.
+
+        ``prefetch=True`` stages the feed dicts onto the device through
+        the double-buffered PrefetchingFeeder (engine/pipeline.py):
+        conversion + ``jax.device_put`` of batch k+1 overlap step k on a
+        background thread, bounded by ``prefetch_depth`` (default: the
+        ``PADDLE_TPU_PREFETCH_DEPTH`` flag)."""
 
         def __reader_creator__():
             if not multi_devices:
@@ -153,4 +160,9 @@ class DataFeeder:
                 for d in chunks:
                     yield d
 
+        if prefetch:
+            from paddle_tpu.engine.pipeline import prefetch_to_device
+
+            return prefetch_to_device(__reader_creator__,
+                                      depth=prefetch_depth)
         return __reader_creator__

@@ -16,6 +16,8 @@ import jax
 from paddle_tpu import observability as obs
 from paddle_tpu.core.types import convert_dtype_to_np
 from paddle_tpu.engine.lowering import BlockProgram, lower_block
+from paddle_tpu.engine.pipeline import (DeferredFetch, DispatchWindow,
+                                        _StepRecord, finite_probes)
 from paddle_tpu.resilience import faultinject
 
 
@@ -89,6 +91,11 @@ class Engine:
         self._cache = collections.OrderedDict()
         self._cache_capacity = int(flags.get_flag("executable_cache_size"))
         self._run_counter = 0
+        # Async dispatch window (engine/pipeline.py): run_block with
+        # dispatch_steps>1 enqueues steps here instead of materializing
+        # their fetches; the window retires the oldest step once depth
+        # is exceeded, sync() drains it, discard() drops it (rollback).
+        self.window = DispatchWindow()
         # Debug guard (reference: FLAGS_check_nan_inf,
         # framework/operator.cc:972-982): verify every fetch and persisted
         # state tensor is finite after each step. Whole-step granularity —
@@ -99,15 +106,45 @@ class Engine:
     # -- public ------------------------------------------------------------
     def run_block(self, program_desc, block_idx, scope, **kwargs):
         """One engine step, wrapped in the telemetry step span (a no-op
-        ctx mgr when PADDLE_TPU_METRICS is down)."""
+        ctx mgr when PADDLE_TPU_METRICS is down).
+
+        ``dispatch_steps=N`` (N>1) enqueues the step into the async
+        dispatch window instead of materializing its fetches: the call
+        returns ``DeferredFetch`` placeholders immediately (JAX async
+        dispatch — the jitted call itself never blocks) and the only
+        host sync is the retire of the OLDEST step once more than N are
+        in flight. ``sync()`` drains the window; deferred
+        ``check_nan_inf`` verdicts surface at retire with the original
+        step index."""
+        dispatch_steps = int(kwargs.pop("dispatch_steps", 1) or 1)
+        defer = dispatch_steps > 1
+        if not defer and len(self.window):
+            # depth changed mid-run (or a windowed run is followed by a
+            # plain one): serialize cleanly before the synchronous step
+            self.window.sync()
         with obs.span("step", step=self._run_counter + 1), \
                 obs.time_block("engine.step_ms"):
             out = self._run_block_impl(program_desc, block_idx, scope,
+                                       dispatch_steps=dispatch_steps,
                                        **kwargs)
-        # liveness: the heartbeat reports this monotonic counter; a rank
-        # whose heartbeats stay fresh while it stops moving is hung
-        obs.health.note_step()
+        if not defer:
+            # liveness: the heartbeat reports this monotonic counter; a
+            # rank whose heartbeats stay fresh while it stops moving is
+            # hung. The windowed path notes enqueue inside the impl and
+            # retire inside the window instead.
+            obs.health.note_step()
         return out
+
+    def sync(self):
+        """Barrier: retire every in-flight windowed step (deferred
+        fetches resolve; deferred nan/inf verdicts raise here)."""
+        self.window.sync()
+
+    def discard_window(self):
+        """Drop the in-flight window without materializing or raising —
+        the rollback path (stale deferred verdicts from a faulted window
+        must not re-raise after the state was restored)."""
+        return self.window.discard()
 
     def _run_block_impl(
         self,
@@ -130,6 +167,7 @@ class Engine:
         remat_segments=0,
         verify=None,
         opt_level=None,
+        dispatch_steps=1,
     ):
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -251,12 +289,28 @@ class Engine:
             # watermark, and the edge-triggered memory_pressure event.
             obs.memory.record_step_memory(scope, step=self._run_counter)
 
+        defer = dispatch_steps > 1
+        probes = []
         if self.check_nan_inf:
-            _check_finite(
-                zip(compiled.block_program.state_out_names, state_out),
-                step=self._run_counter, kind="state")
-            _check_finite(zip(fetch_list, fetches),
-                          step=self._run_counter, kind="fetch")
+            if defer:
+                # Deferred guard: the verdict scalars are dispatched NOW
+                # (in-flight device reductions — the mutated state
+                # buffers are DONATED into the next step, so they cannot
+                # be re-read at retire time) and only materialized when
+                # the window retires this step, where a trip raises with
+                # THIS step's index (engine/pipeline.py _resolve).
+                probes = finite_probes(
+                    zip(compiled.block_program.state_out_names,
+                        state_out), kind="state")
+                probes += finite_probes(zip(fetch_list, fetches),
+                                        kind="fetch")
+            else:
+                _check_finite(
+                    zip(compiled.block_program.state_out_names,
+                        state_out),
+                    step=self._run_counter, kind="state")
+                _check_finite(zip(fetch_list, fetches),
+                              step=self._run_counter, kind="fetch")
 
         if state_writeback:
             for name, val in zip(compiled.block_program.state_out_names,
@@ -269,6 +323,28 @@ class Engine:
             # may read it concurrently with the worker's run. Pairs with
             # donate_state=False (no donation bookkeeping for params).
             obs.inc("engine.infer_runs")
+
+        if defer:
+            # Multi-step dispatch: hand back placeholders and keep the
+            # fetches in flight — the scope state written back above
+            # stays an un-materialized device array too (JAX async
+            # dispatch), so the NEXT run_block dispatches immediately
+            # instead of waiting for this step's results. nbytes is
+            # metadata — no sync in the accounting.
+            if obs.enabled():
+                obs.inc("engine.fetch_bytes",
+                        sum(int(getattr(v, "nbytes", 0))
+                            for v in fetches))
+            record = _StepRecord(
+                step=self._run_counter, fetch_names=list(fetch_list),
+                fetches=list(fetches), probes=probes,
+                return_numpy=return_numpy)
+            record.placeholders = tuple(
+                DeferredFetch(self.window, record, i, name=n)
+                for i, n in enumerate(record.fetch_names))
+            obs.health.note_step_enqueued()
+            self.window.push(record, depth=dispatch_steps)
+            return list(record.placeholders)
 
         if return_numpy:
             # one batched host transfer for all fetches (device_get on the

@@ -58,8 +58,20 @@ class Executor:
 
     def close(self):
         """Graceful shutdown (reference: executor.py close — notifies
-        pservers). Engine caches are dropped."""
+        pservers). The in-flight dispatch window is dropped without
+        materializing (nothing will read the placeholders) and engine
+        caches are cleared."""
+        self.engine.discard_window()
         self.engine._cache.clear()
+
+    def sync(self):
+        """Barrier for multi-step dispatch (``run(...,
+        dispatch_steps=N)``): retires every in-flight step, resolving
+        the outstanding ``DeferredFetch`` placeholders. Deferred
+        ``check_nan_inf`` verdicts raise here, oldest step first, each
+        naming its ORIGINAL step index. A no-op when nothing is in
+        flight (dispatch_steps=1 loops never pay it)."""
+        self.engine.sync()
 
     def cost_analysis(self, program=None, feed=None, fetch_list=None,
                       scope=None, accumulate_steps=1, remat_segments=0,
@@ -144,7 +156,7 @@ class Executor:
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True, accumulate_steps=1, remat_segments=0,
             verify=None, opt_level=None, mesh=None, shard_rules=None,
-            data_axes=("dp",)):
+            data_axes=("dp",), dispatch_steps=None):
         """``accumulate_steps=k`` runs the feed as k micro-batches through a
         compiled scan with one optimizer update on the averaged gradients —
         the batch-merge capability (reference:
@@ -179,6 +191,21 @@ class Executor:
         else single-device compilation. A 1-device mesh is bit-identical
         to no mesh.
 
+        ``dispatch_steps=N`` (default: the ``PADDLE_TPU_DISPATCH_STEPS``
+        flag) enqueues up to N steps into the engine's async dispatch
+        window without blocking on device results: each run returns
+        ``DeferredFetch`` placeholders immediately (shape/dtype readable
+        without blocking; any host use — ``np.asarray``, ``float()`` —
+        resolves them), the only host sync in steady state is the retire
+        of the OLDEST in-flight step, and ``Executor.sync()`` is the
+        barrier that drains the window. Bit-exact with
+        ``dispatch_steps=1``: the same executables run with the same rng
+        counters — only host-materialization timing changes. With
+        ``check_nan_inf`` the verdict is deferred to retire time and
+        reports the original step index; scope state past a blown-up
+        step may be non-finite until a rollback restores it (pair deep
+        windows with ``resilience.ResilientDriver``).
+
         Every run is wrapped in a top-level ``executor.run`` telemetry
         span when ``PADDLE_TPU_METRICS`` is up (paddle_tpu.observability)
         — the outermost host lane of the step timeline."""
@@ -192,18 +219,33 @@ class Executor:
                 accumulate_steps=accumulate_steps,
                 remat_segments=remat_segments, verify=verify,
                 opt_level=opt_level, mesh=mesh, shard_rules=shard_rules,
-                data_axes=data_axes)
+                data_axes=data_axes, dispatch_steps=dispatch_steps)
 
     def _run_impl(self, program=None, feed=None, fetch_list=None,
                   scope=None, return_numpy=True, accumulate_steps=1,
                   remat_segments=0, verify=None, opt_level=None,
-                  mesh=None, shard_rules=None, data_axes=("dp",)):
+                  mesh=None, shard_rules=None, data_axes=("dp",),
+                  dispatch_steps=None):
         from paddle_tpu.compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()
         fetch_list = fetch_list or []
+        explicit_depth = dispatch_steps is not None
+        if dispatch_steps is None:
+            # zero-code-change entry, like PADDLE_TPU_MESH: the flag
+            # turns an existing training loop into a windowed one
+            from paddle_tpu import flags
+
+            dispatch_steps = int(flags.get_flag("dispatch_steps"))
+        dispatch_steps = max(1, int(dispatch_steps))
 
         if isinstance(program, CompiledProgram):
+            if dispatch_steps > 1 and explicit_depth:
+                raise NotImplementedError(
+                    "dispatch_steps>1 is not supported on the "
+                    "CompiledProgram (legacy SPMD) path; use the plain "
+                    "Program with mesh=/PADDLE_TPU_MESH — the GSPMD "
+                    "path composes with the dispatch window")
             if remat_segments:
                 raise NotImplementedError(
                     "remat_segments is not supported on the CompiledProgram "
@@ -254,4 +296,5 @@ class Executor:
             mesh=mesh,
             shard_rules=shard_rules,
             data_axes=tuple(data_axes),
+            dispatch_steps=dispatch_steps,
         )

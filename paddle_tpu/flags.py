@@ -51,6 +51,26 @@ DEFS = {
         "smallest jax.checkpoint segment count that fits. <=0 or an "
         "unknowable device limit disables auto-remat (donation "
         "planning still runs)."),
+    "dispatch_steps": (
+        int, 1,
+        "Depth of the engine's async dispatch window "
+        "(engine/pipeline.py): Executor.run enqueues up to this many "
+        "compiled-block steps without blocking on device results — "
+        "donated scope state stays in flight as device arrays, fetches "
+        "of intermediate steps come back as DeferredFetch placeholders "
+        "resolved by Executor.sync(), the window-overflow retire, or "
+        "first host use (np.asarray/float). 1 = the classic synchronous "
+        "feed->step->fetch loop. check_nan_inf under a deeper window "
+        "defers its verdict to retire time and reports the ORIGINAL "
+        "step index; the heartbeat watchdog classifies hangs on "
+        "RETIRED steps so an N-deep window never false-trips."),
+    "prefetch_depth": (
+        int, 2,
+        "Bounded depth of the PrefetchingFeeder's device-side input "
+        "queue (engine/pipeline.py): a background thread converts + "
+        "jax.device_put-s batch k+1..k+depth while step k runs. 2 = "
+        "classic double buffering. Iterator exhaustion and reader "
+        "exceptions propagate to the consuming thread in order."),
     "executable_cache_size": (
         int, 128,
         "LRU capacity of the engine's compiled-executable cache "

@@ -32,6 +32,8 @@ def save_checkpoint_async(manager, step, main_program=None, scope=None,
     selection as save_persistables). Returns immediately — the step loop
     keeps training while the device->host transfer and writes happen on
     the manager's background thread."""
+    from paddle_tpu import observability as obs
+
     main_program = main_program or default_main_program()
     if scope is None:
         from paddle_tpu.executor import global_scope
@@ -44,7 +46,14 @@ def save_checkpoint_async(manager, step, main_program=None, scope=None,
         val = scope.get(v.name)
         if val is not None:
             arrays[v.name] = val
-    manager.save(step, arrays, blocking=blocking)
+    # the span covers exactly the step-thread cost of the save — the
+    # on-device snapshot copies + queue handoff (checkpoint.py); the
+    # D2H transfer and file writes run on the manager's writer thread.
+    # The pipeline bench's "checkpoint wall hidden fraction" is this
+    # span's wall over the full write wall.
+    with obs.span("ckpt.snapshot", step=int(step), n_vars=len(arrays)), \
+            obs.time_block("ckpt.enqueue_ms"):
+        manager.save(step, arrays, blocking=blocking)
     return sorted(arrays)
 
 

@@ -42,8 +42,12 @@ passive telemetry layer (PR 3/4) into active supervision. Three pieces:
   unhealthy — the load-balancer readiness probe.
 
 Everything here is deliberately cheap on the step path: the engine's
-only per-step call is ``note_step()`` (one int increment + one clock
-read); emitting and classifying run on daemon/supervisor threads.
+only per-step calls are ``note_step()`` — or, under multi-step dispatch,
+``note_step_enqueued()``/``note_step_retired()`` — one int increment +
+one clock read each; emitting and classifying run on daemon/supervisor
+threads. The hang classifier reads the RETIRED counter (the heartbeat's
+``step`` field), so an N-deep async dispatch window (engine/pipeline.py)
+never reads as a stall while results are legitimately in flight.
 """
 
 import collections
@@ -89,26 +93,53 @@ START_GRACE_S = 60.0
 EWMA_ALPHA = 0.3
 
 # -- the per-rank step counter the heartbeat reports ------------------------
-# Plain dict mutation under the GIL: note_step() is the ONE call on the
-# engine's step path and must stay in the ns regime (bench.py
-# counters.health proves it).
-_step_state = {"steps": 0, "ts": None}
+# Plain dict mutation under the GIL: these notes are the only calls on
+# the engine's step path and must stay in the ns regime (bench.py
+# counters.health proves it). Multi-step dispatch (engine/pipeline.py)
+# splits "a step happened" into two edges: ENQUEUED when the host hands
+# the step to the device queue, RETIRED when its results materialize.
+# The hang classifier reads RETIRED ("step" in the heartbeat payload) —
+# an N-deep in-flight window advances its enqueue counter ahead of
+# retirement without ever reading as a stall, while a genuinely wedged
+# device stalls the retire edge no matter how deep the window is.
+_step_state = {"steps": 0, "enqueued": 0, "ts": None, "enq_ts": None}
 
 
 def note_step():
-    """Record one completed engine step (called by Engine.run_block)."""
+    """Record one synchronously completed engine step (enqueue and
+    retire are the same edge at dispatch depth 1)."""
+    note_step_enqueued()
+    note_step_retired()
+
+
+def note_step_enqueued():
+    """The host dispatched a step into the device queue (results may
+    still be in flight)."""
+    _step_state["enqueued"] += 1
+    _step_state["enq_ts"] = time.monotonic()
+
+
+def note_step_retired():
+    """A dispatched step's results materialized (window retire/sync)."""
     _step_state["steps"] += 1
     _step_state["ts"] = time.monotonic()
 
 
 def step_count():
+    """Retired steps — the liveness counter the watchdog classifies."""
     return _step_state["steps"]
 
 
+def enqueued_count():
+    return _step_state["enqueued"]
+
+
 def reset_steps():
-    """Test/bench isolation for the process-local step counter."""
+    """Test/bench isolation for the process-local step counters."""
     _step_state["steps"] = 0
+    _step_state["enqueued"] = 0
     _step_state["ts"] = None
+    _step_state["enq_ts"] = None
 
 
 def host_rss_bytes():
@@ -173,7 +204,11 @@ class HeartbeatEmitter:
         from paddle_tpu import observability as obs
 
         self._seq += 1
+        # "step" is the RETIRED count — what RankHealth classifies hangs
+        # on; "enqueued" rides along so a tailing supervisor can see the
+        # in-flight dispatch window depth (enqueued - step).
         payload = {"seq": self._seq, "step": _step_state["steps"],
+                   "enqueued": _step_state["enqueued"],
                    "interval_ms": self.interval_ms}
         payload["phase"] = obs.tracer.current_phase() or "idle"
         rss = host_rss_bytes()

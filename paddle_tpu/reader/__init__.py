@@ -12,6 +12,7 @@ from paddle_tpu.reader.decorator import (  # noqa: F401
     compose,
     firstn,
     map_readers,
+    prefetch_to_device,
     shuffle,
     xmap_readers,
 )

@@ -94,6 +94,20 @@ def buffered(reader, size):
     return data_reader
 
 
+def prefetch_to_device(reader, depth=None, device_put=True):
+    """Device-side double-buffered prefetch (engine/pipeline.py
+    PrefetchingFeeder as a composable decorator): a background thread
+    converts + ``jax.device_put``-s the next ``depth`` batches
+    (``PADDLE_TPU_PREFETCH_DEPTH``, default 2) while the consumer's
+    current step runs on device — the H2D transfer leaves the critical
+    path. Compose it LAST, over batch/feed-dict readers (e.g.
+    ``DataFeeder.decorate_reader`` output — or pass ``prefetch=True``
+    there); exhaustion and reader exceptions propagate in order."""
+    from paddle_tpu.engine.pipeline import prefetch_to_device as _impl
+
+    return _impl(reader, depth=depth, device_put=device_put)
+
+
 def batch(reader, batch_size, drop_last=False):
     def batch_reader():
         b = []

@@ -51,6 +51,13 @@ class ResilientDriver:
 
     Behaviour per fault (nan/inf trip or injected step fault):
 
+    0. the executor's async dispatch window (``dispatch_steps>1``) is
+       DISCARDED — in-flight steps will be replayed from the
+       checkpoint, so their stale deferred fetches/verdicts must not
+       resolve or re-raise (a deferred ``check_nan_inf`` trip names
+       its original step and rolls back exactly like a synchronous
+       one; the driver also drains the window before every checkpoint
+       save so a poisoned in-flight step can never be published);
     1. the in-flight async save (if any) is joined — never restore
        under a half-written checkpoint;
     2. state rolls back to the latest COMPLETE checkpoint
@@ -106,6 +113,17 @@ class ResilientDriver:
         checkpoint), or None when the root holds none."""
         return self.manager.latest_step()
 
+    def _drain(self):
+        """Barrier the executor's async dispatch window (a no-op at
+        dispatch depth 1). Deferred ``check_nan_inf`` verdicts from
+        in-flight steps raise HERE, naming their original step — the
+        driver drains before every checkpoint save so a poisoned
+        in-flight step can never be published as a 'good' checkpoint
+        (which would become the rollback target and trap the run)."""
+        sync = getattr(self.exe, "sync", None)
+        if sync is not None:
+            sync()
+
     def _rollback(self, failed_step, exc):
         self.rollbacks += 1
         if self.rollbacks > self.max_rollbacks:
@@ -113,7 +131,13 @@ class ResilientDriver:
                 "%d rollbacks exceed the budget of %d (last fault at "
                 "step %d)" % (self.rollbacks, self.max_rollbacks,
                               failed_step)) from exc
-        # join the in-flight save first: it predates the fault (saves
+        # drop the in-flight dispatch window first: its steps are about
+        # to be replayed from the checkpoint, and their stale deferred
+        # verdicts/fetches must neither re-raise nor resolve
+        engine = getattr(self.exe, "engine", None)
+        if engine is not None and hasattr(engine, "discard_window"):
+            engine.discard_window()
+        # join the in-flight save next: it predates the fault (saves
         # happen on good steps) but restoring mid-write would race it
         self.manager.wait()
         try:
@@ -164,7 +188,19 @@ class ResilientDriver:
         results = {}
         skip = set()
         step = start_step
-        while step < n_steps:
+        while True:
+            if step >= n_steps:
+                # drain the dispatch window before the final save: a
+                # deferred fault from an in-flight step rolls back and
+                # re-enters the loop like any step fault
+                try:
+                    self._drain()
+                except Exception as e:  # noqa: BLE001 - filtered below
+                    if not _is_recoverable(e):
+                        raise
+                    step = self._rollback(step, e)
+                    continue
+                break
             # worker-liveness fault points: a supervised-launcher test
             # kills (or wedges, for the heartbeat watchdog) this process
             # here, between steps — the preemption seam (never
@@ -183,7 +219,11 @@ class ResilientDriver:
             except Exception as e:  # noqa: BLE001 - filtered below
                 if not _is_recoverable(e):
                     raise
-                if self.skip_poison_batch:
+                # a deferred verdict surfacing on this run names an
+                # EARLIER step; skipping THIS batch would drop the
+                # wrong one, so the poison-pill escape hatch only
+                # applies to synchronously detected faults
+                if self.skip_poison_batch and "deferred" not in str(e):
                     skip.add(step)
                 step = self._rollback(step, e)
                 continue
@@ -193,6 +233,17 @@ class ResilientDriver:
             step += 1
             if self.ckpt_interval and step % self.ckpt_interval == 0 \
                     and step < n_steps:
+                # drain first: every step the checkpoint will cover must
+                # have retired (and passed its deferred nan verdict) —
+                # publishing a poisoned snapshot would make IT the
+                # rollback target and trap the run in a restore loop
+                try:
+                    self._drain()
+                except Exception as e:  # noqa: BLE001 - filtered below
+                    if not _is_recoverable(e):
+                        raise
+                    step = self._rollback(step, e)
+                    continue
                 self._save(step)
         # final checkpoint marks completion (and is what a restarted
         # gang member resumes past); blocking so the caller returns

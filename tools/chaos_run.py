@@ -23,6 +23,8 @@ Usage::
     python tools/chaos_run.py --steps 30 --nproc 2 --seed 7
     python tools/chaos_run.py --spec 'step_nan@9' --nproc 1
     python tools/chaos_run.py --hang --nproc 2        # heartbeat watchdog
+    python tools/chaos_run.py --dispatch-steps 8 --nproc 1 \
+        --spec 'step_nan@12'   # fault lands mid async dispatch window
 
 CPU-only by construction (workers force JAX_PLATFORMS=cpu); the point
 is recovery-path coverage, not throughput.
@@ -82,16 +84,23 @@ def batch_fn(step, batch=16, seed=0):
 
 
 def train_losses(n_steps, ckpt_root, rank=0, max_rollbacks=8,
-                 on_step=None):
+                 on_step=None, dispatch_steps=1):
     """Train the probe model under a ResilientDriver; returns the
     per-step scalar losses. Faults (if any are scheduled) fire through
-    the engine's real seams; recovery is the driver's problem."""
+    the engine's real seams; recovery is the driver's problem.
+    ``dispatch_steps>1`` runs the loop through the engine's async
+    dispatch window (engine/pipeline.py) — a fault then lands
+    MID-WINDOW and the driver discards the in-flight steps before
+    restoring."""
     import numpy as np
 
     import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags
     from paddle_tpu.checkpoint import CheckpointManager
     from paddle_tpu.resilience import ResilientDriver
 
+    if dispatch_steps and dispatch_steps > 1:
+        flags.set_flags({"dispatch_steps": int(dispatch_steps)})
     main, startup, loss, init = build()
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
@@ -146,14 +155,38 @@ def run_worker(args):
     # survives the respawn, so the full trajectory reassembles
     steps_path = os.path.join(args.result_dir, "rank%d.steps.jsonl" % rank)
     with open(steps_path, "a") as steps_f:
+        # Resolution-aware streaming: forcing float(out[0]) on every
+        # step would retire the dispatch window each time and serialize
+        # it back to depth 1 — instead park placeholders and write them
+        # once they resolve on their own (the window-overflow retire).
+        # A killed incarnation loses at most the in-flight tail, which
+        # the respawn replays from its checkpoint (last-write-wins in
+        # reassemble_steps); rollback-discarded placeholders are
+        # dropped, their replayed steps re-fire on_step.
+        pending = []
+
+        def _flush(force=False):
+            while pending:
+                s, v = pending[0]
+                if getattr(v, "discarded", False):
+                    pending.pop(0)
+                    continue
+                if not force and not getattr(v, "resolved", True):
+                    break
+                steps_f.write(json.dumps(
+                    {"step": s,
+                     "loss": float(np.asarray(v).reshape(-1)[0])}) + "\n")
+                steps_f.flush()
+                pending.pop(0)
+
         def on_step(step, out):
-            steps_f.write(json.dumps(
-                {"step": step,
-                 "loss": float(np.asarray(out[0]).reshape(-1)[0])}) + "\n")
-            steps_f.flush()
+            pending.append((step, out[0]))
+            _flush()
 
         train_losses(args.steps, os.path.join(root, "rank%d" % rank),
-                     rank=rank, on_step=on_step)
+                     rank=rank, on_step=on_step,
+                     dispatch_steps=args.dispatch_steps)
+        _flush(force=True)   # train() drained the window; all resolved
     losses = reassemble_steps(steps_path, args.steps)
     if losses is None:
         print("chaos_run worker %d: incomplete step log" % rank,
@@ -198,6 +231,11 @@ def run_supervisor(args):
     }
     worker_cmd = [os.path.abspath(__file__), "--worker",
                   "--steps", str(args.steps), "--result-dir", result_dir]
+    if args.dispatch_steps > 1:
+        # workers run the async dispatch window; the in-process parity
+        # reference below stays synchronous (flag unset here), so
+        # --check-parity proves faulted windowed == fault-free sync
+        worker_cmd += ["--dispatch-steps", str(args.dispatch_steps)]
     if args.mesh:
         # every worker trains through the mesh-sharded executor path: a
         # dp mesh over 2 virtual devices, selected by the flag the
@@ -308,6 +346,13 @@ def main():
                         help="default: fresh temp dir, kept for forensics")
     parser.add_argument("--result-dir", default=None)
     parser.add_argument("--started_port", type=int, default=6280)
+    parser.add_argument("--dispatch-steps", type=int, default=1,
+                        help="workers enqueue this many steps into the "
+                             "engine's async dispatch window "
+                             "(engine/pipeline.py) — injected faults "
+                             "land mid-window and must still restore "
+                             "to bit-exact parity with the synchronous "
+                             "fault-free reference")
     parser.add_argument("--mesh", action="store_true",
                         help="workers train through the dp-mesh GSPMD "
                              "path (2 virtual devices each) — proves the "
