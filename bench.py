@@ -1084,6 +1084,128 @@ def bench_health_overhead():
     return out
 
 
+def bench_elastic():
+    """Cost of acting on a health verdict — the three elastic paths:
+
+    * ``local_restore_ms`` vs ``quorum_restore_ms`` — the same ~8 MB
+      checkpoint read back from the local root, then (local root wiped)
+      from a peer replica; the delta is the full price of surviving
+      ``disk_fail``, and it should be a file-copy read, not a rebuild.
+    * ``router_reaction_ms`` — wall time from a worker's fast window
+      starting to burn to the FleetRouter's poll thread landing the
+      scale-out; dominated by the poll interval, so ms here proves the
+      detection loop is not the autoscale bottleneck (worker spawn is).
+    * ``shrink_rejit_ms`` — one engine step after a device is marked
+      lost under ``mesh=dp=-1``: mesh re-plan + fresh compile + donated
+      state reshard, i.e. the training gap a shrink inserts. ``None``
+      on single-device hosts (nothing to shrink onto).
+    """
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.resilience.elastic import FleetRouter
+
+    out = {}
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        local = os.path.join(tmp, "local")
+        peers = [os.path.join(tmp, "p1"), os.path.join(tmp, "p2")]
+        state = {"w%d" % i: np.random.RandomState(i).randn(
+            256, 1024).astype(np.float32) for i in range(8)}
+        mgr = CheckpointManager(local, replica_roots=peers, replicas=2)
+        mgr.save(10, state, blocking=True)
+        t0 = time.perf_counter()
+        mgr.restore(10)
+        out["local_restore_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 2)
+        shutil.rmtree(local)
+        os.makedirs(local)
+        mgr = CheckpointManager(local, replica_roots=peers, replicas=2)
+        t0 = time.perf_counter()
+        mgr.restore(10)
+        out["quorum_restore_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 2)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    class _W:  # duck-typed worker: isolates the router's own latency
+        def __init__(self, idx):
+            self.burn = False
+
+        def alive(self):
+            return True
+
+        def burning(self, now=None):
+            return self.burn
+
+        fast_burning = burning
+
+        def slow_recovered(self, now=None):
+            return True
+
+        def burn_snapshot(self, now=None):
+            return {"burn_fast": 5.0 if self.burn else 0.0,
+                    "burn_slow": 0.0, "fast_threshold": 2.0,
+                    "slow_threshold": 3.0}
+
+        def start(self):
+            pass
+
+        def stop(self):
+            pass
+
+    router = FleetRouter(_W, min_workers=1, max_workers=2, cooldown_s=0.0)
+    router.start(poll_interval_s=0.01)
+    try:
+        t0 = time.perf_counter()
+        router.workers[0].burn = True
+        deadline = t0 + 5.0
+        while router.scale_outs < 1 and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        out["router_reaction_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 2) \
+            if router.scale_outs else None
+    finally:
+        router.stop()
+
+    out["shrink_rejit_ms"] = None
+    if len(jax.devices()) >= 2:
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu import flags as _flags
+        from paddle_tpu.framework import Program, program_guard
+        from paddle_tpu.resilience import elastic
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            img = fluid.layers.data(name="ex", shape=[64],
+                                    dtype="float32")
+            hid = fluid.layers.fc(input=img, size=64, act="relu")
+            loss = fluid.layers.mean(fluid.layers.fc(input=hid, size=8))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        feed = {"ex": np.random.RandomState(0).randn(
+            16, 64).astype(np.float32)}
+        _flags.set_flags({"mesh": "dp=-1"})
+        try:
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+                elastic.mark_device_lost(jax.devices()[-1])
+                t0 = time.perf_counter()
+                exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+                out["shrink_rejit_ms"] = round(
+                    (time.perf_counter() - t0) * 1000.0, 2)
+        finally:
+            elastic.reset_lost()
+            _flags.reset_flag("mesh")
+    return out
+
+
 def main():
     from paddle_tpu import flags, observability
 
@@ -1281,6 +1403,14 @@ def main():
         result["counters"]["health"] = bench_health_overhead()
     except Exception as e:  # noqa: BLE001
         errors["health"] = str(e)[:200]
+    try:
+        # elastic-path walls (quorum vs local restore, router reaction,
+        # shrink re-jit): how long a health verdict takes to ACT on —
+        # tracked per round, and in the serving selector too, so the
+        # autoscale reaction budget shows up in BENCH_*.json trends
+        result["counters"]["elastic"] = bench_elastic()
+    except Exception as e:  # noqa: BLE001
+        errors["elastic"] = str(e)[:200]
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

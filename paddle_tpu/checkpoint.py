@@ -24,6 +24,18 @@ Layout of one checkpoint (written under a temp dir, atomically renamed):
 ``index`` records each saved piece's slice into the global shape, so a
 multi-host restore can reassemble exactly like the reference's sliced
 pserver checkpoints (distributed/ps.py does the same with @SHARD_START).
+
+Cross-root replication + quorum (elastic capacity): with
+``replica_roots`` configured and ``PADDLE_TPU_CKPT_REPLICAS`` (or the
+``replicas`` ctor arg) > 0, the writer mirrors each published step dir
+to up to k peer roots, byte-for-byte, under
+``<peer_root>/.replicas/<basename(my_root)>/`` — the same atomic
+tmp+rename publication, so a peer never sees a half replica. Reads then
+become a majority vote over (local root + replica locations): a torn
+local-only save — published locally, crashed before mirroring — cannot
+win ``latest_step()``, and a rank whose local root died (``disk_fail``)
+restores its shards from a peer's replica, byte-identical. Replication
+off (the default) leaves single-root behavior exactly as before.
 """
 
 import json
@@ -38,6 +50,11 @@ import numpy as np
 __all__ = ["CheckpointManager"]
 
 _STEP_RE = re.compile(r"^step_(\d+)(?:\.proc(\d+))?$")
+
+
+class _ShardMissingError(FileNotFoundError):
+    """A step that looks complete (manifest present) lost a shard file
+    at every location holding it — restore falls back a step."""
 
 
 def _read_manifest(step_dir):
@@ -126,10 +143,22 @@ class CheckpointManager:
     """
 
     def __init__(self, root, max_to_keep=3, process_index=None,
-                 process_count=None, max_pending=2):
+                 process_count=None, max_pending=2, replica_roots=None,
+                 replicas=None):
+        from paddle_tpu import flags
+
         self.root = root
         self.max_to_keep = max_to_keep
         self.max_pending = max(1, int(max_pending))
+        # cross-root replication: this rank's shards mirror to up to
+        # ``replicas`` of the given peer roots after each local publish
+        # (0 / no peers = off; reads stay single-root)
+        if replicas is None:
+            replicas = int(flags.get_flag("ckpt_replicas"))
+        self.replicas = max(0, int(replicas))
+        self.replica_roots = [
+            r for r in (replica_roots or [])
+            if os.path.abspath(r) != os.path.abspath(root)]
         # process identity resolves LAZILY at first save: querying jax
         # here would initialize the backend, poisoning a later
         # jax.distributed.initialize() when the manager is constructed
@@ -267,6 +296,12 @@ class CheckpointManager:
                        on_retry=_on_retry)
         except Exception as e:                        # noqa: BLE001
             self._error = e
+            return
+        # replicate AFTER the local publish succeeded, still on the
+        # writer thread (a blocking save's wait() covers the mirror
+        # too). Best-effort: a dead peer costs this step its quorum
+        # vote there, never the local checkpoint.
+        self._mirror(step)
 
     def _write_attempt(self, step, snapshot):
         final = self._dirname(step)
@@ -374,6 +409,60 @@ class CheckpointManager:
                 shutil.rmtree(os.path.join(self.root, d),
                               ignore_errors=True)
 
+    # -- replication -------------------------------------------------------
+    def _replica_dirs(self):
+        """The peer locations this rank's steps mirror to (empty =
+        replication off). Namespaced by the local root's basename so
+        several ranks can share one peer root without colliding."""
+        if not self.replicas or not self.replica_roots:
+            return []
+        base = os.path.basename(os.path.abspath(self.root))
+        return [os.path.join(r, ".replicas", base)
+                for r in self.replica_roots[:self.replicas]]
+
+    def _mirror(self, step):
+        """Copy the just-published step dir(s) to each replica location
+        with the same tmp+rename atomic publication, then apply the
+        max_to_keep window there. Writer-thread only."""
+        from paddle_tpu import observability as obs
+
+        final = self._dirname(step)
+        base = os.path.basename(final)
+        if not os.path.isdir(final):
+            return
+        for rd in self._replica_dirs():
+            try:
+                os.makedirs(rd, exist_ok=True)
+                tmp = os.path.join(rd, "." + base + ".tmp")
+                shutil.rmtree(tmp, ignore_errors=True)
+                shutil.copytree(final, tmp)
+                _fsync_dir(tmp)
+                dst = os.path.join(rd, base)
+                shutil.rmtree(dst, ignore_errors=True)
+                os.rename(tmp, dst)
+                _fsync_dir(rd)
+                if self.max_to_keep:
+                    have = sorted(
+                        int(m.group(1)) for m in
+                        (_STEP_RE.match(d) for d in os.listdir(rd)) if m)
+                    cut = (have[-self.max_to_keep:] or [0])[0]
+                    for d in os.listdir(rd):
+                        m = _STEP_RE.match(d)
+                        if m and int(m.group(1)) < cut:
+                            shutil.rmtree(os.path.join(rd, d),
+                                          ignore_errors=True)
+            except OSError as e:
+                warnings.warn(
+                    "checkpoint replica to %s failed (%s) — step %d has "
+                    "no quorum vote there" % (rd, e, step),
+                    RuntimeWarning)
+                obs.inc("recovery.ckpt_replica_failed")
+                obs.event("ckpt.replica_failed", step=step, dest=rd,
+                          error=str(e)[:200])
+                continue
+            obs.inc("recovery.ckpt_replicated")
+            obs.event("ckpt.replicated", step=step, dest=rd)
+
     # -- lifecycle ---------------------------------------------------------
     def wait(self):
         """Block until every enqueued save has been written (the
@@ -393,23 +482,30 @@ class CheckpointManager:
             return bool(self._pending or self._writing)
 
     # -- restore -----------------------------------------------------------
-    def _step_dirs(self, step=None):
+    def _step_dirs(self, step=None, root=None):
         """{step: [(dir, manifest), ...]} of COMPLETE checkpoints (every
         process dir named by the recorded process_count must be present,
         every manifest readable — a missing/truncated/unparsable
         manifest marks a mid-write crash and hides the dir, see
         _read_manifest). When a root holds BOTH layouts for one step
         (re-saved under a different world size and the cleanup raced),
-        the set with the newest manifest wins — never a silent mix."""
+        the set with the newest manifest wins — never a silent mix.
+        ``root`` defaults to the local root; quorum reads pass a
+        replica location instead."""
+        root = self.root if root is None else root
         found = {}
-        for d in os.listdir(self.root):
+        try:
+            entries_on_disk = os.listdir(root)
+        except OSError:
+            return {}        # location gone entirely (dead disk/peer)
+        for d in entries_on_disk:
             m = _STEP_RE.match(d)
             if not m:
                 continue
             s = int(m.group(1))
             if step is not None and s != step:
                 continue
-            path = os.path.join(self.root, d)
+            path = os.path.join(root, d)
             manifest = _read_manifest(path)
             if manifest is None:
                 continue
@@ -436,23 +532,130 @@ class CheckpointManager:
         return complete
 
     def all_steps(self):
-        return sorted(self._step_dirs())
+        """Sorted complete steps. Single-root: exactly the local dirs.
+        With replication configured: a majority vote over the locations
+        that hold ANY complete step (an empty/poisoned location is not
+        a voter — else a wiped disk would veto the surviving replicas)
+        — a step published on a minority of locations (the torn-save
+        signature: local publish, crash before mirror) does not
+        appear."""
+        replica_dirs = self._replica_dirs()
+        if not replica_dirs:
+            return sorted(self._step_dirs())
+        votes = {}
+        voters = 0
+        for loc in [self.root] + replica_dirs:
+            steps = set(self._step_dirs(root=loc))
+            if not steps:
+                continue
+            voters += 1
+            for s in steps:
+                votes[s] = votes.get(s, 0) + 1
+        if not voters:
+            return []
+        need = voters // 2 + 1
+        return sorted(s for s, v in votes.items() if v >= need)
 
     def latest_step(self):
         steps = self.all_steps()
-        return steps[-1] if steps else None
+        best = steps[-1] if steps else None
+        if self._replica_dirs():
+            # a local step NEWER than the quorum winner lost the vote —
+            # the torn-save forensic record (ckpt.quorum_reject)
+            torn = [s for s in sorted(self._step_dirs())
+                    if best is None or s > best]
+            if torn:
+                from paddle_tpu import observability as obs
+
+                obs.inc("recovery.ckpt_quorum_reject")
+                obs.event("ckpt.quorum_reject", steps=torn, chosen=best)
+        return best
 
     def restore(self, step=None):
         """-> {name: np.ndarray} reassembled to global shape, merging
-        every process's manifest (multi-host layouts)."""
+        every process's manifest (multi-host layouts).
+
+        Degraded-read ladder: the local root is tried first; a step
+        whose local dir lost a shard file (bit rot, partial disk loss)
+        or is gone entirely is retried from each replica location
+        (``ckpt.quorum_restore`` — byte-identical, the mirror is a
+        file copy); only when NO location can serve the step does
+        restore fall back to the previous complete step
+        (``ckpt.missing_shard`` + ``ckpt.restore_fallback``, mirroring
+        the corrupt-manifest fallback). An EXPLICITLY requested step
+        that is absent everywhere still raises — only a step that
+        looks complete but cannot be read falls back."""
+        explicit = step is not None
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint under %s" % self.root)
-        entries = self._step_dirs(step).get(step)
-        if not entries:
-            raise FileNotFoundError(
-                "checkpoint step %s incomplete or absent under %s"
-                % (step, self.root))
+        steps = self.all_steps()
+        tries = [step] + [s for s in reversed(steps) if s < step]
+        last_err = None
+        for i, s in enumerate(tries):
+            try:
+                out = self._restore_step(s)
+            except _ShardMissingError as e:
+                from paddle_tpu import observability as obs
+
+                obs.inc("recovery.ckpt_restore_fallback")
+                obs.event("ckpt.restore_fallback", step=s,
+                          error=str(e)[:200])
+                last_err = e
+                continue
+            except FileNotFoundError as e:
+                if i == 0 and explicit:
+                    raise        # the requested step never existed
+                last_err = e
+                continue
+            if i > 0:
+                warnings.warn(
+                    "checkpoint step %s unreadable; restored step %s "
+                    "instead" % (step, s), RuntimeWarning)
+            return out
+        raise FileNotFoundError(
+            "no readable checkpoint under %s (tried steps %s)"
+            % (self.root, tries)) from last_err
+
+    def _restore_step(self, step):
+        """Load one step, trying the local root then each replica
+        location. Raises FileNotFoundError when no location holds the
+        step, _ShardMissingError when every location that holds it is
+        missing a shard file."""
+        shard_err = None
+        for li, loc in enumerate([self.root] + self._replica_dirs()):
+            entries = self._step_dirs(step, root=loc).get(step)
+            if not entries:
+                continue
+            try:
+                out = self._load_entries(entries)
+            except (FileNotFoundError, OSError, ValueError) as e:
+                from paddle_tpu import observability as obs
+
+                warnings.warn(
+                    "checkpoint step %d at %s is missing a shard file "
+                    "(%s)" % (step, loc, e), RuntimeWarning)
+                obs.inc("recovery.ckpt_missing_shard")
+                obs.event("ckpt.missing_shard", step=step, location=loc,
+                          error=str(e)[:200])
+                shard_err = e
+                continue
+            if li > 0:
+                from paddle_tpu import observability as obs
+
+                obs.inc("recovery.ckpt_quorum_restore")
+                obs.event("ckpt.quorum_restore", step=step, source=loc)
+            return out
+        if shard_err is not None:
+            raise _ShardMissingError(
+                "checkpoint step %s unreadable at every location"
+                % step) from shard_err
+        raise FileNotFoundError(
+            "checkpoint step %s incomplete or absent under %s"
+            % (step, self.root))
+
+    @staticmethod
+    def _load_entries(entries):
         out = {}
         filled = {}
         for d, manifest in entries:

@@ -17,6 +17,14 @@ resume from the latest complete checkpoint (resilience.ResilientDriver
 picks it up). Every restart is a ``recovery.restart`` telemetry
 counter/event.
 
+Elastic shrink (``--max-shrinks`` / ``PADDLE_TPU_MAX_SHRINKS``): a
+PERMANENT loss — a worker exiting with faultinject.LOST_EXIT_CODE (45),
+or any failure after the restart budget is spent — re-launches the
+SURVIVING gang one worker smaller instead of giving up: the job keeps
+running on reduced capacity (``health.mesh_shrunk`` event), and workers
+see ``PADDLE_TPU_SHRINK_COUNT`` so elastic scripts re-plan their device
+mesh (resilience/elastic.py).
+
 Usage:  python -m paddle_tpu.distributed.launch --nproc 2 \
             --max-restarts 3 --recovery-dir /ckpt train.py [args]
 """
@@ -60,7 +68,8 @@ def launch_processes(script_args, nproc=1, started_port=6170,
     return procs
 
 
-def wait_gang(procs, poll_interval=0.1, term_grace=10.0, monitor=None):
+def wait_gang(procs, poll_interval=0.1, term_grace=10.0, monitor=None,
+              result=None):
     """Poll ALL workers until the gang resolves; returns the gang rc.
 
     The seed launcher's sequential ``p.wait()`` hung forever when a
@@ -77,13 +86,22 @@ def wait_gang(procs, poll_interval=0.1, term_grace=10.0, monitor=None):
     the gang is terminated the same way and ``health.HUNG_EXIT_CODE``
     is returned — a hung collective no longer blocks the job forever.
     Only ranks whose process is still alive are consulted: a worker
-    that exited 0 stops heartbeating legitimately."""
+    that exited 0 stops heartbeating legitimately.
+
+    ``result`` (optional dict) receives ``failed_rank``/``rc`` for the
+    first failing (or first unhealthy) worker — the identity the
+    supervisor's gang-shrink path needs to know WHICH capacity was
+    lost."""
     while True:
         rcs = [p.poll() for p in procs]
-        failed = next((rc for rc in rcs if rc not in (None, 0)), None)
-        if failed is not None:
+        failed_rank = next(
+            (i for i, rc in enumerate(rcs) if rc not in (None, 0)), None)
+        if failed_rank is not None:
+            if result is not None:
+                result["failed_rank"] = failed_rank
+                result["rc"] = rcs[failed_rank]
             _terminate_survivors(procs, term_grace)
-            return failed
+            return rcs[failed_rank]
         if all(rc == 0 for rc in rcs):
             return 0
         if monitor is not None:
@@ -101,6 +119,9 @@ def wait_gang(procs, poll_interval=0.1, term_grace=10.0, monitor=None):
                 # the supervisor's sink even with metrics gated off
                 obs.tracer.event("health.hang_detected", ranks=desc)
                 obs.flush_sink()
+                if result is not None:
+                    result["failed_rank"] = sorted(bad)[0]
+                    result["rc"] = health.HUNG_EXIT_CODE
                 print("paddle_tpu.launch: unhealthy rank(s) %s — "
                       "terminating the gang" % desc,
                       file=sys.stderr, flush=True)
@@ -131,7 +152,8 @@ def _terminate_survivors(procs, term_grace=10.0):
 def supervise(script_args, nproc=1, started_port=6170,
               node_ip="127.0.0.1", env_extra=None, max_restarts=None,
               recovery_dir=None, backoff=None, capture_output=False,
-              on_gang=None, heartbeat_ms=None, hang_timeout_s=None):
+              on_gang=None, heartbeat_ms=None, hang_timeout_s=None,
+              max_shrinks=None, stats=None):
     """Launch the gang under supervision; returns the final rc.
 
     Restarts the WHOLE gang (terminate survivors, backoff, respawn) on
@@ -142,6 +164,20 @@ def supervise(script_args, nproc=1, started_port=6170,
     ``recovery_dir`` is given, ``PADDLE_TPU_RECOVERY_CKPT`` to resume
     from. ``on_gang(procs, attempt)`` observes each spawned gang
     (tests).
+
+    Elastic shrink: a PERMANENT loss — a worker exiting with
+    ``faultinject.LOST_EXIT_CODE`` (45: dead host, failed VM), or any
+    failure once the restart budget is spent — relaunches the SURVIVING
+    gang one worker smaller instead of giving up, while ``max_shrinks``
+    (default: the PADDLE_TPU_MAX_SHRINKS flag, 0) lasts. Each shrink
+    emits ``health.mesh_shrunk`` (ungated — the incident record) and
+    bumps ``PADDLE_TPU_RESTART_COUNT`` like a restart, so restart-gated
+    fault entries do not re-fire; workers additionally see
+    ``PADDLE_TPU_SHRINK_COUNT`` so an elastic training script can
+    re-plan its device mesh over the surviving capacity
+    (resilience/elastic.py). Shrinks do not consume the restart budget.
+    ``stats`` (optional dict) receives
+    restarts/shrinks/final_nproc/lost_ranks on exit.
 
     Liveness: whenever a metrics sink is configured for the workers,
     heartbeats are auto-enabled (``PADDLE_TPU_HEARTBEAT_MS`` exported
@@ -154,10 +190,13 @@ def supervise(script_args, nproc=1, started_port=6170,
     from paddle_tpu import observability as obs
     from paddle_tpu.observability import health
     from paddle_tpu.observability.export import host_tagged_path
+    from paddle_tpu.resilience.faultinject import LOST_EXIT_CODE
     from paddle_tpu.resilience.retrying import Backoff
 
     if max_restarts is None:
         max_restarts = int(flags.get_flag("max_restarts"))
+    if max_shrinks is None:
+        max_shrinks = int(flags.get_flag("max_shrinks"))
     backoff = backoff if backoff is not None else Backoff(
         base=0.5, factor=2.0, cap=30.0, jitter=0.5)
     sink_base = ((env_extra or {}).get("PADDLE_TPU_METRICS_SINK")
@@ -169,10 +208,21 @@ def supervise(script_args, nproc=1, started_port=6170,
         hb_ms = float(raw) if raw else float(flags.get_flag("heartbeat_ms"))
         if hb_ms <= 0 and sink_base:
             hb_ms = health.DEFAULT_SUPERVISED_HEARTBEAT_MS
-    attempt = 0
+    attempt = 0          # incarnation counter (PADDLE_TPU_RESTART_COUNT)
+    restarts = 0         # spent against max_restarts
+    shrinks = 0          # spent against max_shrinks
+    lost_ranks = []
+
+    def _finish(rc):
+        if stats is not None:
+            stats.update(rc=rc, restarts=restarts, shrinks=shrinks,
+                         final_nproc=nproc, lost_ranks=list(lost_ranks))
+        return rc
+
     while True:
         env = dict(env_extra or {})
         env["PADDLE_TPU_RESTART_COUNT"] = str(attempt)
+        env["PADDLE_TPU_SHRINK_COUNT"] = str(shrinks)
         if recovery_dir:
             env["PADDLE_TPU_RECOVERY_CKPT"] = recovery_dir
         monitor = None
@@ -187,19 +237,43 @@ def supervise(script_args, nproc=1, started_port=6170,
                                  capture_output=capture_output)
         if on_gang is not None:
             on_gang(procs, attempt)
-        rc = wait_gang(procs, monitor=monitor)
+        res = {}
+        rc = wait_gang(procs, monitor=monitor, result=res)
         if rc == 0:
-            return 0
-        if attempt >= max_restarts:
-            obs.event("recovery.giveup", rc=rc, restarts=attempt)
-            return rc
-        delay = backoff.delay(attempt)
+            return _finish(0)
+        permanent = (rc == LOST_EXIT_CODE)
+        if ((permanent or restarts >= max_restarts)
+                and shrinks < max_shrinks and nproc > 1):
+            # the lost rank is never coming back (or restarting has
+            # stopped helping): give up on THAT capacity, keep the job
+            lost = res.get("failed_rank", nproc - 1)
+            lost_ranks.append(lost)
+            nproc -= 1
+            shrinks += 1
+            attempt += 1
+            obs.inc("health.mesh_shrunk")
+            # direct tracer event: the shrink record must land in the
+            # supervisor's sink even with metrics gated off
+            obs.tracer.event("health.mesh_shrunk", lost_rank=lost, rc=rc,
+                             nproc=nproc, shrinks=shrinks)
+            obs.flush_sink()
+            print("paddle_tpu.launch: rank %d permanently lost (rc %s); "
+                  "shrinking the gang to %d worker(s) [shrink %d/%d]"
+                  % (lost, rc, nproc, shrinks, max_shrinks),
+                  file=sys.stderr, flush=True)
+            time.sleep(backoff.delay(0))
+            continue
+        if restarts >= max_restarts:
+            obs.event("recovery.giveup", rc=rc, restarts=restarts)
+            return _finish(rc)
+        delay = backoff.delay(restarts)
+        restarts += 1
         attempt += 1
         obs.inc("recovery.restart")
-        obs.event("recovery.restart", rc=rc, attempt=attempt,
+        obs.event("recovery.restart", rc=rc, attempt=restarts,
                   backoff_s=round(delay, 3))
         print("paddle_tpu.launch: gang failed (rc %s); restart %d/%d "
-              "in %.1fs" % (rc, attempt, max_restarts, delay),
+              "in %.1fs" % (rc, restarts, max_restarts, delay),
               file=sys.stderr, flush=True)
         time.sleep(delay)
 
@@ -214,6 +288,13 @@ def main():
     parser.add_argument("--max-restarts", type=int, default=None,
                         help="gang restart budget (default: the "
                              "PADDLE_TPU_MAX_RESTARTS flag, 0)")
+    parser.add_argument("--max-shrinks", type=int, default=None,
+                        help="elastic shrink budget: on a PERMANENT "
+                             "worker loss (rc 45, or an exhausted "
+                             "restart budget) relaunch the surviving "
+                             "gang one worker smaller up to this many "
+                             "times (default: the "
+                             "PADDLE_TPU_MAX_SHRINKS flag, 0)")
     parser.add_argument("--recovery-dir", default=None,
                         help="checkpoint root exported to workers as "
                              "PADDLE_TPU_RECOVERY_CKPT (default: the "
@@ -238,7 +319,8 @@ def main():
                        args.node_ip, max_restarts=args.max_restarts,
                        recovery_dir=recovery_dir,
                        heartbeat_ms=args.heartbeat_ms,
-                       hang_timeout_s=args.hang_timeout))
+                       hang_timeout_s=args.hang_timeout,
+                       max_shrinks=args.max_shrinks))
 
 
 if __name__ == "__main__":

@@ -222,11 +222,25 @@ class Engine:
             # previous rule table trips pjit's donation check otherwise
             # (the "two rule tables, one scope" sequence). Reshard only
             # on mismatch; steady-state steps pass through untouched.
+            # This same seam migrates live donated state onto a SHRUNK
+            # mesh after an elastic device loss (resilience/elastic.py):
+            # mesh_from_flag re-plans over the survivors, mesh_signature
+            # keys a fresh executable, and the mismatch branch moves the
+            # arrays — counted so shrink recovery is observable.
             _, mut_sh, _ = compiled.in_shardings
-            mutated = [
-                jax.device_put(v, s)
-                if isinstance(v, jax.Array) and v.sharding != s else v
-                for v, s in zip(mutated, mut_sh)]
+            moved = 0
+            resharded = []
+            for v, s in zip(mutated, mut_sh):
+                if isinstance(v, jax.Array) and v.sharding != s:
+                    v = jax.device_put(v, s)
+                    moved += 1
+                resharded.append(v)
+            mutated = resharded
+            if moved:
+                obs.inc("engine.state_resharded", moved)
+                obs.event("engine.state_resharded", arrays=moved,
+                          mesh=dict((str(k), int(n))
+                                    for k, n in mesh.shape.items()))
 
         self._run_counter += 1
         # The PRNG key is derived INSIDE the jitted function from two scalar

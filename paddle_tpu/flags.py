@@ -170,13 +170,59 @@ DEFS = {
         "lasts, re-launches it after exponential backoff + jitter; "
         "0 = no restarts (fail fast, but still terminate the "
         "surviving gang and propagate the rc)."),
+    "max_shrinks": (
+        int, 0,
+        "Gang-shrink budget of the supervised launcher "
+        "(paddle_tpu.distributed.launch): when a rank is PERMANENTLY "
+        "lost (worker_loss exit, rc 45, or the restart budget is "
+        "exhausted) and this budget remains, the supervisor relaunches "
+        "the surviving gang one worker smaller instead of giving up — "
+        "capacity degrades, the job completes. Each shrink emits a "
+        "health.mesh_shrunk event. 0 = never shrink (a permanent loss "
+        "fails the job once restarts run out)."),
+    "ckpt_replicas": (
+        int, 0,
+        "Cross-root checkpoint replication factor (checkpoint.py): "
+        "after each local atomic publish the writer mirrors the step "
+        "dir to up to this many peer roots (CheckpointManager "
+        "replica_roots), latest_step() becomes a majority vote across "
+        "the local root + replicas (a torn local-only save loses), and "
+        "restore() falls back to a peer's byte-identical replica when "
+        "the local root is gone or poisoned (disk_fail). 0 = off "
+        "(single-root behavior, exactly as before)."),
+    "lost_devices": (
+        str, "",
+        "Comma-separated device ids the elastic layer treats as "
+        "permanently lost (resilience/elastic.py): mesh_from_flag "
+        "re-plans any 'dp=-1' axis over the surviving devices only, so "
+        "the engine re-jits on the shrunk mesh (new mesh_signature "
+        "cache entry) and donated state is resharded on the next step. "
+        "Normally set via elastic.mark_device_lost(); empty = all "
+        "devices healthy."),
+    "fleet_min_workers": (
+        int, 1,
+        "Lower bound of the SLO-driven serving fleet "
+        "(resilience/elastic.FleetRouter): scale-in never retires the "
+        "fleet below this many InferenceServer workers."),
+    "fleet_max_workers": (
+        int, 4,
+        "Upper bound of the SLO-driven serving fleet: scale-out on a "
+        "fast-window burn stops adding workers at this size."),
+    "fleet_cooldown_s": (
+        float, 5.0,
+        "Hysteresis window of the serving fleet autoscaler: after any "
+        "scale action the router makes no further scaling decision for "
+        "this long, so a burn that flaps around the threshold cannot "
+        "thrash the fleet."),
     "fault_spec": (
         str, "",
         "Deterministic fault-injection schedule "
         "(paddle_tpu.resilience.faultinject): ';'-separated "
         "point@cond:cond entries, e.g. "
         "'step_nan@7;worker_kill@rank1:step12'. Points: step_nan, "
-        "step_fail, compile, ckpt_write, worker_kill, worker_hang. "
+        "step_fail, compile, ckpt_write, worker_kill, worker_hang, "
+        "worker_loss (permanent — the supervisor shrinks instead of "
+        "restarting), disk_fail (poisons the local checkpoint root). "
         "Empty = no faults (the production default; the check is one "
         "env read)."),
     "recovery_ckpt": (

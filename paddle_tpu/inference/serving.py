@@ -85,7 +85,7 @@ class InferenceServer:
 
     def __init__(self, program, feed_names, fetch_names, scope=None,
                  executor=None, buckets=None, max_wait_ms=None,
-                 name="serving", slo_ms=None):
+                 name="serving", slo_ms=None, slo_monitor=None):
         from paddle_tpu import flags
         from paddle_tpu.executor import Executor, global_scope
         from paddle_tpu.observability.health import SloMonitor
@@ -106,9 +106,15 @@ class InferenceServer:
             slo_ms = float(flags.get_flag("serving_slo_ms"))
         # latency SLO burn-rate monitor (observability/health.py): fed
         # unconditionally in _dispatch — readiness is not gated by the
-        # metrics flag
-        self.slo = SloMonitor(slo_ms, name=name) \
-            if slo_ms and slo_ms > 0 else None
+        # metrics flag. ``slo_monitor`` injects a pre-built monitor
+        # (custom windows/thresholds — the FleetRouter and
+        # serve_probe --autoscale shorten the windows so scaling
+        # decisions are demonstrable in seconds)
+        if slo_monitor is not None:
+            self.slo = slo_monitor
+        else:
+            self.slo = SloMonitor(slo_ms, name=name) \
+                if slo_ms and slo_ms > 0 else None
         self._queue = []
         self._cond = threading.Condition()
         self._stopping = False
@@ -176,6 +182,48 @@ class InferenceServer:
     def run(self, feed, timeout=None):
         return self.submit(feed).result(timeout)
 
+    def alive(self):
+        """True while the dispatch worker thread is running — the cheap
+        liveness check the FleetRouter routes on."""
+        return bool(self._started and self._worker is not None
+                    and self._worker.is_alive())
+
+    def burning(self, now=None):
+        """Live SLO alert condition (BOTH burn windows over threshold);
+        False without an SLO monitor."""
+        return bool(self.slo is not None and self.slo.burning(now=now))
+
+    def fast_burning(self, now=None):
+        """FAST-window-only burn — the early detection signal the
+        FleetRouter scales OUT on, before the slow window would confirm
+        a page. False without an SLO monitor."""
+        if self.slo is None:
+            return False
+        return (self.slo.burn_rate(self.slo.fast_window_s, now=now)
+                >= self.slo.fast_burn)
+
+    def slow_recovered(self, now=None):
+        """True once the SLOW burn window is back under threshold — the
+        confirmation signal the FleetRouter requires fleet-wide before
+        scaling IN (a brief lull never sheds capacity). True without an
+        SLO monitor."""
+        if self.slo is None:
+            return True
+        return (self.slo.burn_rate(self.slo.slow_window_s, now=now)
+                < self.slo.slow_burn)
+
+    def burn_snapshot(self, now=None):
+        """{'burn_fast', 'burn_slow', thresholds} for scale-decision
+        forensics, or None without an SLO monitor."""
+        if self.slo is None:
+            return None
+        return {"burn_fast": self.slo.burn_rate(self.slo.fast_window_s,
+                                                now=now),
+                "burn_slow": self.slo.burn_rate(self.slo.slow_window_s,
+                                                now=now),
+                "fast_threshold": self.slo.fast_burn,
+                "slow_threshold": self.slo.slow_burn}
+
     def health(self):
         """Readiness snapshot for a load-balancer probe: healthy =
         worker thread alive AND (with an SLO configured) not burning
@@ -186,8 +234,7 @@ class InferenceServer:
         now = time.monotonic()
         with self._cond:
             depth = len(self._queue)
-        alive = bool(self._started and self._worker is not None
-                     and self._worker.is_alive())
+        alive = self.alive()
         out = {"name": self.name, "started": self._started,
                "worker_alive": alive, "queue_depth": depth,
                "last_dispatch_age_s":

@@ -31,10 +31,23 @@ def make_mesh(axes, devices=None):
     return Mesh(dev_array, axis_names=tuple(names))
 
 
+def _available_devices():
+    """The device pool meshes plan over: ``jax.devices()`` minus any
+    permanently-lost devices recorded in the elastic registry
+    (resilience/elastic.py) — the seam that makes ``dp=-1`` re-plan
+    smaller after a shrink instead of crashing on a gone chip."""
+    try:
+        from paddle_tpu.resilience import elastic
+        return elastic.surviving_devices()
+    except Exception:
+        return list(jax.devices())
+
+
 def parse_mesh_spec(spec):
     """``"dp=4,tp=2" -> {"dp": 4, "tp": 2}`` (the PADDLE_TPU_MESH
     grammar; also the lint_program --mesh grammar). ``"dp=-1"`` means
-    "all remaining devices" and may appear on at most one axis."""
+    "all remaining devices" — the SURVIVING pool after any elastic
+    shrink — and may appear on at most one axis."""
     axes = {}
     for part in str(spec).split(","):
         part = part.strip()
@@ -52,7 +65,7 @@ def parse_mesh_spec(spec):
         raise ValueError("mesh spec %r has more than one -1 axis" % spec)
     if wild:
         fixed = int(np.prod([s for s in axes.values() if s != -1]))
-        n_dev = len(jax.devices())
+        n_dev = len(_available_devices())
         if n_dev % fixed:
             raise ValueError(
                 "mesh spec %r: %d devices not divisible by fixed axes %d"
@@ -71,7 +84,7 @@ def mesh_from_flag():
     spec = flags.get_flag("mesh")
     if not spec:
         return None
-    return make_mesh(parse_mesh_spec(spec))
+    return make_mesh(parse_mesh_spec(spec), devices=_available_devices())
 
 
 def mesh_signature(mesh):

@@ -5,7 +5,7 @@ loss and numeric blow-ups (ISSUE 5; PAPERS.md: TensorFlow's
 checkpoint/restore-centric fault-tolerance design, MLPerf-scale TPU-pod
 preemption-as-routine).
 
-Three pieces:
+Four pieces:
 
 * ``retrying``    — one shared backoff/deadline/jitter policy
   (pserver connects, checkpoint writes, gang restarts);
@@ -13,19 +13,36 @@ Three pieces:
   seams, scheduled by ``PADDLE_TPU_FAULT_SPEC`` so every recovery path
   runs in CPU-only tests;
 * ``driver``      — the rollback-on-fault step loop around
-  ``Executor.run`` + a ``CheckpointManager``.
+  ``Executor.run`` + a ``CheckpointManager``;
+* ``elastic``     — acting on permanent loss WITHOUT losing the job:
+  the lost-device registry ``dp=-1`` meshes re-plan over, the
+  ``LOST_EXIT_CODE`` the supervisor's gang-shrink path keys on, and
+  the SLO-burn-driven serving ``FleetRouter``.
 
 The supervised elastic launcher lives in ``distributed/launch.py``
 (it IS the launcher, grown a supervisor) and reads
-``PADDLE_TPU_MAX_RESTARTS`` / ``PADDLE_TPU_RECOVERY_CKPT``.
+``PADDLE_TPU_MAX_RESTARTS`` / ``PADDLE_TPU_MAX_SHRINKS`` /
+``PADDLE_TPU_RECOVERY_CKPT``.
 """
 
-from paddle_tpu.resilience import driver, faultinject, retrying  # noqa: F401
+from paddle_tpu.resilience import (  # noqa: F401
+    driver,
+    elastic,
+    faultinject,
+    retrying,
+)
 from paddle_tpu.resilience.driver import (  # noqa: F401
     FaultBudgetExceeded,
     ResilientDriver,
 )
+from paddle_tpu.resilience.elastic import (  # noqa: F401
+    FleetRouter,
+    mark_device_lost,
+    reset_lost,
+    surviving_devices,
+)
 from paddle_tpu.resilience.faultinject import (  # noqa: F401
+    LOST_EXIT_CODE,
     InjectedFault,
     fault_point,
 )
@@ -37,7 +54,9 @@ from paddle_tpu.resilience.retrying import (  # noqa: F401
 )
 
 __all__ = [
-    "Backoff", "DeadlineExceeded", "FaultBudgetExceeded", "InjectedFault",
-    "ResilientDriver", "RetriesExhausted", "driver", "fault_point",
-    "faultinject", "retry_call", "retrying",
+    "Backoff", "DeadlineExceeded", "FaultBudgetExceeded", "FleetRouter",
+    "InjectedFault", "LOST_EXIT_CODE", "ResilientDriver",
+    "RetriesExhausted", "driver", "elastic", "fault_point", "faultinject",
+    "mark_device_lost", "reset_lost", "retry_call", "retrying",
+    "surviving_devices",
 ]

@@ -124,6 +124,21 @@ class ResilientDriver:
         if sync is not None:
             sync()
 
+    def _poison_ckpt_root(self, step):
+        """Destroy the manager's LOCAL checkpoint root in place (the
+        ``disk_fail`` fault point's corruption): join the writer first
+        so no save races the rmtree, then wipe. With
+        ``PADDLE_TPU_CKPT_REPLICAS`` > 0 the manager's quorum restore
+        path recovers every later restore from a peer root's replica."""
+        import os
+        import shutil
+
+        self.manager.wait()
+        shutil.rmtree(self.manager.root, ignore_errors=True)
+        os.makedirs(self.manager.root, exist_ok=True)
+        obs.inc("recovery.disk_poisoned")
+        obs.event("ckpt.root_poisoned", step=step, root=self.manager.root)
+
     def _rollback(self, failed_step, exc):
         self.rollbacks += 1
         if self.rollbacks > self.max_rollbacks:
@@ -207,6 +222,12 @@ class ResilientDriver:
             # mid-device-step in real life either)
             fault_point("worker_kill", step=step)
             fault_point("worker_hang", step=step)
+            fault_point("worker_loss", step=step)
+            if fault_point("disk_fail", step=step):
+                # poison-style: the driver owns the checkpoint root, so
+                # IT destroys it — the dead-local-disk scenario quorum
+                # restore recovers from via a peer root's replica
+                self._poison_ckpt_root(step)
             if step in skip:
                 obs.inc("recovery.batch_skipped")
                 step += 1

@@ -1,0 +1,341 @@
+"""Elastic capacity: act on health verdicts without losing the job.
+
+PR 9 gave the system eyes — hung/dead rank classification, SLO burn-rate
+windows behind ``InferenceServer.health()`` — but the only actuator was
+"restart the whole gang". This module adds the three actuators the
+ROADMAP's elastic-capacity rung names (PAPERS.md: MLPerf pod-scale
+practice treats worker loss as routine, arXiv:1909.09756; TensorFlow's
+design goal of tolerating worker loss without restarting the world,
+arXiv:1605.08695):
+
+* **Lost-device registry** — the training-side shrink seam.
+  ``mark_device_lost(id)`` records a device as permanently gone (and
+  mirrors the set into ``PADDLE_TPU_LOST_DEVICES`` so respawned workers
+  inherit it); ``parallel.mesh.mesh_from_flag`` then re-plans any
+  ``dp=-1`` axis over ``surviving_devices()`` only. The engine's
+  executable cache keys on ``mesh_signature``, so the shrunk mesh is
+  automatically a fresh compile, and the single-process donated-state
+  path reshards live arrays onto it (``jax.device_put`` on sharding
+  mismatch) — no engine change needed beyond what already exists.
+
+* **LOST_EXIT_CODE / gang shrink** — re-exported from ``faultinject``;
+  ``distributed/launch.supervise`` treats a gang failure with this rc
+  (or an exhausted restart budget) as permanent and, within
+  ``PADDLE_TPU_MAX_SHRINKS``, relaunches the surviving gang one worker
+  smaller (``health.mesh_shrunk`` event) — each survivor resumes from
+  its last complete checkpoint via the normal recovery path.
+
+* **FleetRouter** — the serving-side actuator: a round-robin router
+  over ``InferenceServer`` workers that scales OUT when any worker's
+  FAST burn-rate window trips (detection speed: acting before the slow
+  window confirms is the point — capacity arrives while the SLO can
+  still be saved) and scales IN only once every worker's SLOW window
+  has recovered (confirmation: a brief lull does not shed capacity),
+  with a cooldown between actions and hard min/max bounds
+  (``PADDLE_TPU_FLEET_MIN_WORKERS`` / ``_MAX_WORKERS`` /
+  ``_COOLDOWN_S``). Requests route to live, non-burning workers first.
+
+Every decision is observable: ``fleet.scale_out`` / ``fleet.scale_in``
+counters, ``health.fleet_scaled`` events, ``fleet.spawn_ms`` timing.
+"""
+
+import threading
+import time
+
+from paddle_tpu import flags
+from paddle_tpu.resilience.faultinject import LOST_EXIT_CODE  # noqa: F401
+
+__all__ = ["LOST_EXIT_CODE", "FleetRouter", "lost_device_ids",
+           "mark_device_lost", "reset_lost", "surviving_devices"]
+
+
+# --- lost-device registry --------------------------------------------------
+# In-process marks union with the PADDLE_TPU_LOST_DEVICES flag (which
+# set_flags mirrors into the environment, so a supervisor's verdict
+# reaches respawned workers for free).
+
+_lost_lock = threading.Lock()
+_lost = set()
+
+
+def _flag_lost():
+    raw = flags.get_flag("lost_devices")
+    out = set()
+    for part in str(raw).split(","):
+        part = part.strip()
+        if part:
+            out.add(int(part))
+    return out
+
+
+def lost_device_ids():
+    """The set of device ids currently considered permanently lost:
+    in-process marks plus the PADDLE_TPU_LOST_DEVICES flag."""
+    with _lost_lock:
+        return _lost | _flag_lost()
+
+
+def mark_device_lost(device):
+    """Record ``device`` (a jax device or an int id) as permanently
+    lost and mirror the full set into the flag/env so subprocesses and
+    later ``mesh_from_flag`` calls re-plan without it."""
+    dev_id = int(getattr(device, "id", device))
+    with _lost_lock:
+        _lost.add(dev_id)
+        all_lost = _lost | _flag_lost()
+    flags.set_flags(
+        {"lost_devices": ",".join(str(i) for i in sorted(all_lost))})
+    from paddle_tpu import observability as obs
+
+    obs.inc("elastic.device_lost")
+    obs.event("elastic.device_lost", device=dev_id,
+              lost=sorted(all_lost))
+    return dev_id
+
+
+def reset_lost():
+    """Forget every lost-device mark (test isolation)."""
+    with _lost_lock:
+        _lost.clear()
+    flags.reset_flag("lost_devices")
+
+
+def surviving_devices():
+    """``jax.devices()`` minus the lost set — the device pool a
+    ``dp=-1`` mesh axis re-plans over."""
+    import jax
+
+    lost = lost_device_ids()
+    if not lost:
+        return list(jax.devices())
+    return [d for d in jax.devices() if int(d.id) not in lost]
+
+
+# --- serving fleet ---------------------------------------------------------
+class FleetRouter:
+    """SLO-driven autoscaler + round-robin router over InferenceServer
+    workers.
+
+    ``factory(index) -> worker`` builds one worker (typically an
+    ``InferenceServer`` wrapping the shared frozen program; the factory
+    owns warmup so a scaled-out worker arrives pre-compiled). The
+    router ``start()``s it and routes ``submit()`` calls round-robin
+    over live workers, preferring ones whose SLO monitor is not
+    burning; with every worker burning it still routes (degraded beats
+    dropped).
+
+    Scaling policy (``maybe_scale``, one decision per call — drive it
+    from the poll thread via ``start(poll_interval_s=...)`` or directly
+    with a synthetic clock in tests):
+
+    * scale OUT when any worker's FAST burn window trips
+      (``InferenceServer.fast_burning``), the fleet is below
+      ``max_workers``, and the cooldown has passed — the fast window is
+      the detection signal, so capacity arrives BEFORE the slow window
+      would confirm a page;
+    * scale IN when no fast window is burning, EVERY worker's SLOW
+      window has recovered (``InferenceServer.slow_recovered``), the
+      fleet is above ``min_workers``, and the cooldown has passed —
+      the newest worker is drained (``stop()`` resolves its queue) and
+      retired;
+    * the cooldown between any two actions is the hysteresis that
+      keeps a threshold-flapping burn from thrashing the fleet.
+    """
+
+    def __init__(self, factory, min_workers=None, max_workers=None,
+                 cooldown_s=None, clock=time.monotonic):
+        self.factory = factory
+        self.min_workers = (int(flags.get_flag("fleet_min_workers"))
+                            if min_workers is None else int(min_workers))
+        self.max_workers = (int(flags.get_flag("fleet_max_workers"))
+                            if max_workers is None else int(max_workers))
+        if self.min_workers < 1:
+            raise ValueError("fleet min_workers must be >= 1")
+        if self.max_workers < self.min_workers:
+            raise ValueError(
+                "fleet max_workers (%d) < min_workers (%d)"
+                % (self.max_workers, self.min_workers))
+        self.cooldown_s = (float(flags.get_flag("fleet_cooldown_s"))
+                           if cooldown_s is None else float(cooldown_s))
+        self.clock = clock
+        self.workers = []
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self.last_spawn_ms = None
+        #: burn snapshot of the worker that triggered the latest
+        #: scale-out — proves the decision fired on the FAST window
+        #: while the slow window was still quiet (tools/serve_probe.py
+        #: --autoscale asserts exactly this)
+        self.last_scale_out_burn = None
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._spawned = 0
+        self._last_scale = None
+        self._poll = None
+        self._stopping = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, poll_interval_s=None):
+        """Spawn up to ``min_workers`` and optionally a daemon poll
+        thread calling ``maybe_scale`` every ``poll_interval_s``."""
+        while self.n_workers < self.min_workers:
+            self._add(self._build_worker())
+        if poll_interval_s:
+            self._stopping = False
+            self._poll = threading.Thread(
+                target=self._poll_loop, args=(float(poll_interval_s),),
+                name="paddle-tpu-fleet", daemon=True)
+            self._poll.start()
+        return self
+
+    def stop(self):
+        """Stop the poll thread and drain + stop every worker (each
+        worker's ``stop()`` resolves its queued futures first)."""
+        self._stopping = True
+        if self._poll is not None:
+            self._poll.join()
+            self._poll = None
+        with self._lock:
+            workers, self.workers = list(self.workers), []
+        for w in workers:
+            w.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _poll_loop(self, interval_s):
+        while not self._stopping:
+            try:
+                self.maybe_scale()
+            except Exception:   # a sick worker probe must not kill scaling
+                pass
+            time.sleep(interval_s)
+
+    def _build_worker(self):
+        """Build + start one worker OUTSIDE the router lock — a model
+        build takes seconds, and in-flight ``submit`` calls must keep
+        routing to the existing fleet while the new capacity warms."""
+        from paddle_tpu import observability as obs
+
+        with self._lock:
+            idx = self._spawned
+            self._spawned += 1
+        t0 = time.perf_counter()
+        w = self.factory(idx)
+        start = getattr(w, "start", None)
+        if start is not None:
+            start()                      # idempotent on InferenceServer
+        self.last_spawn_ms = (time.perf_counter() - t0) * 1000.0
+        obs.observe("fleet.spawn_ms", self.last_spawn_ms)
+        return w
+
+    def _add(self, w):
+        from paddle_tpu import observability as obs
+
+        with self._lock:
+            self.workers.append(w)
+            n = len(self.workers)
+        obs.set_gauge("fleet.workers", n)
+        return n
+
+    # -- routing ---------------------------------------------------------
+    @property
+    def n_workers(self):
+        with self._lock:
+            return len(self.workers)
+
+    def submit(self, feed):
+        """Route one request; returns the worker's Future."""
+        return self._pick().submit(feed)
+
+    def _pick(self):
+        with self._lock:
+            workers = list(self.workers)
+            self._rr += 1
+            offset = self._rr
+        if not workers:
+            raise RuntimeError("FleetRouter has no workers (start() it)")
+        n = len(workers)
+        order = [workers[(offset + k) % n] for k in range(n)]
+        alive = [w for w in order if w.alive()]
+        if not alive:
+            raise RuntimeError("FleetRouter: no live workers in a fleet "
+                               "of %d" % n)
+        # prefer workers not burning their SLO budget; if everyone is
+        # burning, degraded service still beats dropping the request
+        for w in alive:
+            if not w.burning():
+                return w
+        return alive[0]
+
+    # -- scaling ---------------------------------------------------------
+    def maybe_scale(self, now=None):
+        """One scaling decision; returns +1 (scaled out), -1 (scaled
+        in), or 0. ``now`` defaults to the router's clock and is passed
+        through to the workers' burn-rate windows so tests can drive a
+        synthetic timeline."""
+        from paddle_tpu import observability as obs
+
+        now = self.clock() if now is None else now
+        with self._lock:
+            workers = list(self.workers)
+            last = self._last_scale
+        if not workers:
+            return 0
+        in_cooldown = (last is not None
+                       and (now - last) < self.cooldown_s)
+        fast = [w for w in workers if w.fast_burning(now=now)]
+        if fast:
+            if in_cooldown or len(workers) >= self.max_workers:
+                return 0
+            trigger = fast[0]
+            snap_fn = getattr(trigger, "burn_snapshot", None)
+            self.last_scale_out_burn = snap_fn(now=now) if snap_fn \
+                else None
+            size = self._add(self._build_worker())
+            with self._lock:
+                self._last_scale = now
+            self.scale_outs += 1
+            obs.inc("fleet.scale_out")
+            obs.event("health.fleet_scaled", direction="out",
+                      workers=size, spawn_ms=round(self.last_spawn_ms
+                                                   or 0.0, 1),
+                      burn=self.last_scale_out_burn)
+            return 1
+        if (len(workers) > self.min_workers and not in_cooldown
+                and all(w.slow_recovered(now=now) for w in workers)):
+            with self._lock:
+                if len(self.workers) <= self.min_workers:
+                    return 0
+                w = self.workers.pop()
+                size = len(self.workers)
+                self._last_scale = now
+            w.stop()                     # drains its queue first
+            self.scale_ins += 1
+            obs.inc("fleet.scale_in")
+            obs.set_gauge("fleet.workers", size)
+            obs.event("health.fleet_scaled", direction="in",
+                      workers=size)
+            return -1
+        return 0
+
+    def stats(self):
+        return {"workers": self.n_workers, "scale_outs": self.scale_outs,
+                "scale_ins": self.scale_ins,
+                "last_spawn_ms": self.last_spawn_ms,
+                "last_scale_out_burn": self.last_scale_out_burn}
+
+    def health(self):
+        """Fleet-level readiness: per-worker snapshots plus the verdict
+        a load balancer wants (any live worker = routable)."""
+        with self._lock:
+            workers = list(self.workers)
+        snaps = [w.health() for w in workers]
+        return {"workers": len(workers),
+                "healthy": any(s.get("worker_alive") for s in snaps),
+                "scale_outs": self.scale_outs,
+                "scale_ins": self.scale_ins,
+                "per_worker": snaps}

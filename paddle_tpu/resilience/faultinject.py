@@ -47,6 +47,15 @@ Registered points and what firing does:
                  Only the supervisor's heartbeat watchdog
                  (observability/health.py) can clear it; restart-gated
                  like worker_kill so the respawned gang does not re-hang
+    worker_loss  hard process exit with LOST_EXIT_CODE — a PERMANENT
+                 loss (dead host, failed VM): restarting the same rank
+                 is pointless, so the supervisor shrinks the gang to
+                 the survivors (distributed/launch.py --max-shrinks)
+                 instead of burning the restart budget
+    disk_fail    returns True to the caller, which poisons its LOCAL
+                 checkpoint root (the ResilientDriver rmtree-s it) —
+                 the dead-local-disk scenario checkpoint quorum restore
+                 recovers from via a peer root's replica
 """
 
 import os
@@ -55,18 +64,22 @@ import time
 from paddle_tpu import flags
 
 __all__ = ["InjectedFault", "FaultEntry", "FaultSchedule", "KILLED_EXIT_CODE",
-           "active", "fault_point", "parse_fault_spec", "random_spec",
-           "reset"]
+           "LOST_EXIT_CODE", "active", "fault_point", "parse_fault_spec",
+           "random_spec", "reset"]
 
 KILLED_EXIT_CODE = 43
+#: a PERMANENTLY lost worker (dead host): the supervisor must shrink
+#: the gang over the survivors, not respawn this rank
+LOST_EXIT_CODE = 45
 
 #: points that RETURN True instead of raising — the caller applies the
-#: corruption itself (the engine owns the arrays to poison)
-POISON_POINTS = frozenset(["step_nan"])
+#: corruption itself (the engine owns the arrays to poison; the driver
+#: owns the checkpoint root to destroy)
+POISON_POINTS = frozenset(["step_nan", "disk_fail"])
 
 KNOWN_POINTS = frozenset(
     ["step_nan", "step_fail", "compile", "ckpt_write", "worker_kill",
-     "worker_hang"])
+     "worker_hang", "worker_loss", "disk_fail"])
 
 
 class InjectedFault(RuntimeError):
@@ -154,7 +167,7 @@ def random_spec(seed, n_steps, nproc=1, kinds=("worker_kill", "step_nan")):
     parts = []
     for kind in kinds:
         conds = ["step%d" % rng.randint(lo, hi)]
-        if kind in ("worker_kill", "worker_hang"):
+        if kind in ("worker_kill", "worker_hang", "worker_loss"):
             conds.insert(0, "rank%d" % rng.randrange(nproc))
         parts.append(kind + "@" + ":".join(conds))
     return ";".join(parts)
@@ -226,15 +239,18 @@ def fault_point(name, step=None):
     obs.inc("faultinject.fired")
     obs.inc("faultinject.%s.fired" % name)
     obs.event("faultinject", point=name, step=step, entry=repr(entry))
-    if name == "worker_kill":
+    if name in ("worker_kill", "worker_loss"):
         # flush telemetry, then die the way a preempted worker dies:
         # immediately, skipping atexit/finally (os._exit) — siblings see
-        # a vanished peer, the supervisor sees a non-zero exit
+        # a vanished peer, the supervisor sees a non-zero exit. A
+        # worker_loss exits with the PERMANENT code: this host is never
+        # coming back, so the supervisor shrinks instead of respawning
         try:
             obs.flush_sink()
         except Exception:
             pass
-        os._exit(KILLED_EXIT_CODE)
+        os._exit(KILLED_EXIT_CODE if name == "worker_kill"
+                 else LOST_EXIT_CODE)
     if name == "worker_hang":
         # wedge the step loop forever WITHOUT exiting: the heartbeat
         # daemon keeps beating with a frozen step counter — exactly the

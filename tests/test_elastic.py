@@ -1,0 +1,567 @@
+"""Elastic capacity: act on health verdicts without losing capacity.
+
+Covers the three actuators of resilience/elastic.py plus their seams:
+the lost-device registry that makes ``dp=-1`` meshes re-plan smaller
+(with bit-exact shrink/restore loss parity on the real engine), the
+supervisor's gang-shrink path keyed on ``LOST_EXIT_CODE``, checkpoint
+replica placement + cross-root quorum restore, and the SLO-burn-driven
+serving FleetRouter's hysteresis."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.distributed.launch import supervise
+from paddle_tpu.parallel.mesh import mesh_from_flag, mesh_signature
+from paddle_tpu.resilience import Backoff, elastic, faultinject
+from paddle_tpu.resilience.elastic import FleetRouter
+from paddle_tpu.resilience.faultinject import (LOST_EXIT_CODE,
+                                               fault_point,
+                                               parse_fault_spec,
+                                               random_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic():
+    """No lost-device marks, mesh flags, or fault specs leak across
+    tests (mark_device_lost/set_flags mirror into the environment)."""
+    yield
+    obs.set_enabled(None)
+    obs.reset()
+    elastic.reset_lost()
+    for name in ("mesh", "fault_spec", "max_shrinks", "max_restarts",
+                 "ckpt_replicas", "fleet_min_workers",
+                 "fleet_max_workers", "fleet_cooldown_s"):
+        flags.reset_flag(name)
+    faultinject.reset()
+
+
+def _arm(spec):
+    flags.set_flags({"fault_spec": spec})
+    faultinject.reset()
+
+
+def _py(code):
+    return ["-c", code]
+
+
+# ---------------------------------------------------------------------------
+# fault points: worker_loss / disk_fail
+# ---------------------------------------------------------------------------
+
+class TestFaultPoints:
+    def test_worker_loss_and_disk_fail_parse(self):
+        entries = parse_fault_spec(
+            "worker_loss@rank1:step7;disk_fail@step3")
+        assert entries[0].point == "worker_loss"
+        assert entries[0].rank == 1 and entries[0].step == 7
+        assert entries[1].point == "disk_fail" and entries[1].step == 3
+
+    def test_random_spec_rank_pins_worker_loss(self):
+        spec = random_spec(3, 40, nproc=4, kinds=("worker_loss",))
+        (entry,) = parse_fault_spec(spec)
+        assert entry.point == "worker_loss"
+        assert entry.rank is not None and 0 <= entry.rank < 4
+
+    def test_disk_fail_is_poison_style(self):
+        """disk_fail RETURNS True (the caller owns the root to wipe)
+        rather than raising, and only on its scheduled step."""
+        _arm("disk_fail@step5")
+        assert fault_point("disk_fail", step=4) is False
+        assert fault_point("disk_fail", step=5) is True
+        assert fault_point("disk_fail", step=5) is False  # fired once
+
+    def test_worker_loss_exit_code_reaches_supervisor(self):
+        """worker_loss os._exits with LOST_EXIT_CODE (45) — distinct
+        from worker_kill's 43, so the supervisor can tell 'respawn me'
+        from 'I am never coming back'."""
+        code = ("import os; "
+                "os.environ['PADDLE_TPU_FAULT_SPEC']='worker_loss';"
+                "import sys; sys.path.insert(0, %r);"
+                "from paddle_tpu.resilience.faultinject import "
+                "fault_point; fault_point('worker_loss')" % REPO)
+        rc = supervise(_py(code), nproc=1, max_restarts=0, max_shrinks=0)
+        assert rc == LOST_EXIT_CODE == 45
+        assert LOST_EXIT_CODE != faultinject.KILLED_EXIT_CODE
+
+
+# ---------------------------------------------------------------------------
+# lost-device registry + mesh re-plan
+# ---------------------------------------------------------------------------
+
+class TestLostDeviceRegistry:
+    def test_mark_and_survivors(self):
+        n = len(jax.devices())
+        assert len(elastic.surviving_devices()) == n
+        elastic.mark_device_lost(jax.devices()[-1])
+        ids = [d.id for d in elastic.surviving_devices()]
+        assert len(ids) == n - 1 and jax.devices()[-1].id not in ids
+
+    def test_marks_mirror_to_env_for_respawned_workers(self):
+        elastic.mark_device_lost(3)
+        elastic.mark_device_lost(1)
+        assert os.environ.get("PADDLE_TPU_LOST_DEVICES") == "1,3"
+        # a "respawned" registry (fresh in-process set) still sees them
+        elastic._lost.clear()
+        assert elastic.lost_device_ids() == {1, 3}
+
+    @needs8
+    def test_mesh_from_flag_replans_over_survivors(self):
+        """dp=-1 re-plans over the surviving pool, and the shrunk mesh
+        has a NEW signature — i.e. a fresh compile-cache entry, never an
+        aliased executable from the bigger mesh."""
+        flags.set_flags({"mesh": "dp=-1"})
+        big = mesh_from_flag()
+        assert dict(big.shape) == {"dp": 8}
+        elastic.mark_device_lost(6)
+        elastic.mark_device_lost(7)
+        small = mesh_from_flag()
+        assert dict(small.shape) == {"dp": 6}
+        assert mesh_signature(big) != mesh_signature(small)
+
+
+# ---------------------------------------------------------------------------
+# supervised gang shrink
+# ---------------------------------------------------------------------------
+
+class TestGangShrink:
+    def test_shrink_on_lost_exit_code(self):
+        """The highest rank dies PERMANENTLY (rc 45) in incarnation 0;
+        the supervisor must relaunch the survivors one smaller — without
+        spending the restart budget — and the job completes."""
+        code = ("import os, sys; "
+                "rank = int(os.environ['PADDLE_TRAINER_ID']); "
+                "n = int(os.environ['PADDLE_TRAINERS_NUM']); "
+                "shrinks = int(os.environ['PADDLE_TPU_SHRINK_COUNT']); "
+                "os._exit(45) if shrinks == 0 and rank == n - 1 "
+                "else sys.exit(0)")
+        stats = {}
+        rc = supervise(_py(code), nproc=3, max_restarts=0, max_shrinks=2,
+                       stats=stats,
+                       backoff=Backoff(base=0.01, jitter=0.0))
+        assert rc == 0
+        assert stats["shrinks"] == 1 and stats["restarts"] == 0
+        assert stats["final_nproc"] == 2 and stats["lost_ranks"] == [2]
+
+    def test_shrink_budget_exhausted_returns_rc(self):
+        stats = {}
+        rc = supervise(_py("import os; os._exit(45)"), nproc=2,
+                       max_restarts=0, max_shrinks=1, stats=stats,
+                       backoff=Backoff(base=0.01, jitter=0.0))
+        assert rc == LOST_EXIT_CODE
+        assert stats["shrinks"] == 1 and stats["final_nproc"] == 1
+
+    def test_exhausted_restart_budget_falls_back_to_shrink(self):
+        """A repeatedly-failing gang whose restart budget is spent is
+        treated as a permanent loss: shrink instead of giving up."""
+        code = ("import os, sys; "
+                "sys.exit(0 if int(os.environ['PADDLE_TPU_SHRINK_COUNT'])"
+                " else 7)")
+        stats = {}
+        rc = supervise(_py(code), nproc=2, max_restarts=1, max_shrinks=1,
+                       stats=stats,
+                       backoff=Backoff(base=0.01, jitter=0.0))
+        assert rc == 0
+        assert stats["restarts"] == 1 and stats["shrinks"] == 1
+        assert stats["final_nproc"] == 1
+
+    def test_no_shrink_without_budget(self):
+        """Default max_shrinks=0: rc 45 propagates like any failure —
+        existing supervision semantics are unchanged."""
+        rc = supervise(_py("import os; os._exit(45)"), nproc=2,
+                       max_restarts=0,
+                       backoff=Backoff(base=0.01, jitter=0.0))
+        assert rc == LOST_EXIT_CODE
+
+
+# ---------------------------------------------------------------------------
+# checkpoint replica placement + quorum restore
+# ---------------------------------------------------------------------------
+
+def _state(scale=1.0):
+    return {"qw": (np.arange(24, dtype=np.float32) * scale).reshape(4, 6),
+            "qb": np.full(6, 0.5 * scale, dtype=np.float32)}
+
+
+class TestCheckpointQuorum:
+    def test_save_mirrors_to_peer_roots(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "local"),
+                                replica_roots=[str(tmp_path / "peer")],
+                                replicas=1)
+        mgr.save(10, _state(), blocking=True)
+        rep = os.path.join(str(tmp_path / "peer"), ".replicas", "local",
+                           "step_10")
+        assert os.path.isdir(rep)
+        assert sorted(f for f in os.listdir(rep)) == \
+            sorted(os.listdir(os.path.join(str(tmp_path / "local"),
+                                           "step_10")))
+
+    def test_quorum_restore_byte_identical_after_poisoned_root(
+            self, tmp_path):
+        """The local root dies (disk_fail's corruption); a fresh manager
+        on the wiped root must still find step 10 via the quorum vote
+        and restore BYTE-identical arrays from a peer replica."""
+        import shutil
+
+        local = str(tmp_path / "local")
+        peers = [str(tmp_path / "p1"), str(tmp_path / "p2")]
+        want = _state(scale=3.0)
+        CheckpointManager(local, replica_roots=peers,
+                          replicas=2).save(10, want, blocking=True)
+        shutil.rmtree(local)
+        os.makedirs(local)
+        obs.reset()
+        obs.set_enabled(True)
+        mgr = CheckpointManager(local, replica_roots=peers, replicas=2)
+        assert mgr.latest_step() == 10
+        got = mgr.restore()
+        for k in want:
+            assert got[k].dtype == want[k].dtype
+            assert got[k].tobytes() == want[k].tobytes()
+        counters = obs.snapshot()["counters"]
+        assert counters.get("recovery.ckpt_quorum_restore", 0) >= 1
+
+    def test_torn_save_loses_quorum_vote(self, tmp_path):
+        """A save that published locally but died before mirroring is a
+        TORN save: 1 vote of 3 locations loses, so latest_step() answers
+        the older, fully-replicated step — a half-written newest step
+        can never win the restore."""
+        local = str(tmp_path / "local")
+        peers = [str(tmp_path / "p1"), str(tmp_path / "p2")]
+        CheckpointManager(local, replica_roots=peers,
+                          replicas=2).save(10, _state(), blocking=True)
+        # the torn step: written by a manager with no replica config,
+        # exactly what a crash between publish and mirror leaves behind
+        CheckpointManager(local).save(20, _state(9.0), blocking=True)
+        obs.reset()
+        obs.set_enabled(True)
+        mgr = CheckpointManager(local, replica_roots=peers, replicas=2)
+        assert mgr.latest_step() == 10
+        assert 20 not in mgr.all_steps()
+        counters = obs.snapshot()["counters"]
+        assert counters.get("recovery.ckpt_quorum_reject", 0) >= 1
+        # single-root managers are not quorum voters: unchanged contract
+        assert CheckpointManager(local).latest_step() == 20
+
+    def test_missing_shard_falls_back_to_previous_step(self, tmp_path):
+        """A step dir missing a shard file emits ckpt.missing_shard and
+        restores the previous complete step — mirroring the existing
+        corrupt-manifest fallback instead of raising."""
+        root = str(tmp_path / "ck")
+        mgr = CheckpointManager(root)
+        mgr.save(5, _state(1.0), blocking=True)
+        mgr.save(10, _state(2.0), blocking=True)
+        os.remove(os.path.join(root, "step_10", "qw.npy"))
+        obs.reset()
+        obs.set_enabled(True)
+        with pytest.warns(RuntimeWarning):
+            got = mgr.restore()
+        assert np.array_equal(got["qw"], _state(1.0)["qw"])
+        counters = obs.snapshot()["counters"]
+        assert counters.get("recovery.ckpt_missing_shard", 0) >= 1
+        assert counters.get("recovery.ckpt_restore_fallback", 0) >= 1
+
+    def test_explicitly_requested_absent_step_still_raises(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(5, _state(), blocking=True)
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(step=999)
+
+
+# ---------------------------------------------------------------------------
+# mesh shrink on the real engine: bit-exact restore/replay parity
+# ---------------------------------------------------------------------------
+
+def _build_mlp():
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="ew1"),
+                            bias_attr=False)
+        pred = fluid.layers.fc(input=h, size=4,
+                               param_attr=fluid.ParamAttr(name="ew2"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    init = {
+        "ew1": np.linspace(-0.4, 0.4, 8 * 16).astype(
+            np.float32).reshape(8, 16),
+        "ew2": np.linspace(0.3, -0.3, 16 * 4).astype(
+            np.float32).reshape(16, 4),
+    }
+    return main, startup, loss, init
+
+
+def _batch(step, batch=16):
+    W = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    rng = np.random.RandomState(2000 + step)
+    xv = rng.randn(batch, 8).astype(np.float32)
+    yv = np.argmax(xv @ W, 1).astype(np.int64).reshape(-1, 1)
+    return {"x": xv, "y": yv}
+
+
+def _span(exe, main, loss, scope, lo, hi):
+    out = []
+    for s in range(lo, hi):
+        r = exe.run(main, feed=_batch(s), fetch_list=[loss], scope=scope)
+        out.append(float(np.asarray(r[0]).reshape(-1)[0]))
+    return out
+
+
+def _shrink_parity(tmp_path, lost_at_start, lost_mid_run):
+    """Train under PADDLE_TPU_MESH=dp=-1, checkpoint, lose devices
+    MID-RUN on the live executor (mesh re-plans + donated state
+    reshards in place), and require the continued trajectory to be
+    bit-exact with a fresh executor that restores the checkpoint
+    directly onto the shrunk mesh and replays."""
+    flags.set_flags({"mesh": "dp=-1"})
+    for d in lost_at_start:
+        elastic.mark_device_lost(d)
+    main, startup, loss, init = _build_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for k, v in init.items():
+            scope.set(k, v)
+        _span(exe, main, loss, scope, 0, 6)
+        snap = {k: np.asarray(scope.get(k)) for k in init}
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(6, snap, blocking=True)
+        # the shrink: the same live executor's next step re-plans the
+        # mesh and migrates the donated state onto the survivors
+        for d in lost_mid_run:
+            elastic.mark_device_lost(d)
+        obs.reset()
+        obs.set_enabled(True)
+        continued = _span(exe, main, loss, scope, 6, 12)
+        resharded = obs.snapshot()["counters"].get(
+            "engine.state_resharded", 0)
+    assert resharded >= 1, \
+        "live shrink never migrated the donated state"
+    # reference: a respawned worker — fresh everything, restore the
+    # checkpoint onto the already-shrunk mesh, replay the same steps
+    main2, startup2, loss2, init2 = _build_mlp()
+    exe2 = fluid.Executor()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        got = CheckpointManager(str(tmp_path / "ck")).restore(6)
+        for k in init2:
+            scope2.set(k, got[k])
+        replayed = _span(exe2, main2, loss2, scope2, 6, 12)
+    assert continued == replayed, (
+        "shrunk-mesh continuation diverged from restore-and-replay:\n"
+        "continued %r\nreplayed  %r" % (continued, replayed))
+    return continued
+
+
+class TestMeshShrinkParity:
+    @needs8
+    def test_dp4_to_dp2(self, tmp_path):
+        losses = _shrink_parity(tmp_path, lost_at_start=(4, 5, 6, 7),
+                                lost_mid_run=(2, 3))
+        assert all(np.isfinite(losses))
+
+    @needs8
+    def test_dp2_to_dp1(self, tmp_path):
+        losses = _shrink_parity(tmp_path,
+                                lost_at_start=(2, 3, 4, 5, 6, 7),
+                                lost_mid_run=(1,))
+        assert all(np.isfinite(losses))
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter hysteresis (synthetic clock + duck-typed workers)
+# ---------------------------------------------------------------------------
+
+class _FakeWorker:
+    def __init__(self, idx):
+        self.idx = idx
+        self.started = False
+        self.stopped = False
+        self.fast = False
+        self.slow_ok = True
+        self.submitted = []
+
+    def alive(self):
+        return self.started and not self.stopped
+
+    def burning(self, now=None):
+        return self.fast
+
+    def fast_burning(self, now=None):
+        return self.fast
+
+    def slow_recovered(self, now=None):
+        return self.slow_ok
+
+    def burn_snapshot(self, now=None):
+        return {"burn_fast": 5.0, "burn_slow": 0.8,
+                "fast_threshold": 2.0, "slow_threshold": 3.0}
+
+    def submit(self, feed):
+        self.submitted.append(feed)
+        return "f%d" % self.idx
+
+    def start(self):
+        self.started = True
+
+    def stop(self):
+        self.stopped = True
+
+    def health(self):
+        return {"worker_alive": self.alive()}
+
+
+def _router(**kw):
+    t = [0.0]
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 3)
+    kw.setdefault("cooldown_s", 5.0)
+    r = FleetRouter(_FakeWorker, clock=lambda: t[0], **kw)
+    r.start()
+    return r, t
+
+
+class TestFleetRouter:
+    def test_scale_out_on_fast_burn_records_trigger_burn(self):
+        r, t = _router()
+        assert r.maybe_scale() == 0          # calm: no action
+        r.workers[0].fast = True
+        assert r.maybe_scale() == 1 and r.n_workers == 2
+        # the proof the decision fired on the FAST window while the
+        # slow window was still under threshold
+        snap = r.last_scale_out_burn
+        assert snap["burn_fast"] >= snap["fast_threshold"]
+        assert snap["burn_slow"] < snap["slow_threshold"]
+
+    def test_cooldown_blocks_thrash_and_max_bounds(self):
+        r, t = _router()
+        r.workers[0].fast = True
+        assert r.maybe_scale() == 1
+        assert r.maybe_scale() == 0          # cooldown hysteresis
+        t[0] += 6.0
+        assert r.maybe_scale() == 1 and r.n_workers == 3
+        t[0] += 6.0
+        assert r.maybe_scale() == 0          # hard max bound
+        assert r.scale_outs == 2
+
+    def test_scale_in_needs_slow_recovery_and_respects_min(self):
+        r, t = _router(min_workers=1, max_workers=2)
+        r.workers[0].fast = True
+        assert r.maybe_scale() == 1
+        r.workers[0].fast = False
+        t[0] += 6.0
+        r.workers[1].slow_ok = False
+        assert r.maybe_scale() == 0          # slow window not recovered
+        r.workers[1].slow_ok = True
+        newest = r.workers[-1]
+        assert r.maybe_scale() == -1 and r.n_workers == 1
+        assert newest.stopped, "retired worker must be drained/stopped"
+        t[0] += 6.0
+        assert r.maybe_scale() == 0          # min bound holds
+        assert r.scale_ins == 1
+
+    def test_routing_skips_dead_and_prefers_non_burning(self):
+        r, t = _router(min_workers=3, max_workers=3)
+        r.workers[0].stopped = True
+        r.workers[1].fast = True             # alive but burning
+        assert r.submit({"x": 1}) == "f2"    # live + not burning wins
+        r.workers[2].stopped = True
+        assert r.submit({"x": 2}) == "f1"    # degraded beats dropped
+        r.workers[1].stopped = True
+        with pytest.raises(RuntimeError):
+            r.submit({"x": 3})
+
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError):
+            FleetRouter(_FakeWorker, min_workers=0)
+        with pytest.raises(ValueError):
+            FleetRouter(_FakeWorker, min_workers=3, max_workers=2)
+
+    def test_flag_defaults(self):
+        flags.set_flags({"fleet_min_workers": 2, "fleet_max_workers": 5,
+                         "fleet_cooldown_s": 1.5})
+        r = FleetRouter(_FakeWorker)
+        assert (r.min_workers, r.max_workers, r.cooldown_s) == (2, 5, 1.5)
+
+    def test_poll_thread_drives_scaling(self):
+        r = FleetRouter(_FakeWorker, min_workers=1, max_workers=2,
+                        cooldown_s=0.0)
+        r.start(poll_interval_s=0.02)
+        try:
+            r.workers[0].fast = True
+            deadline = time.monotonic() + 5.0
+            while r.n_workers < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert r.n_workers == 2
+        finally:
+            r.stop()
+        assert all(w.stopped for w in [])    # stop() drained the fleet
+        assert r.n_workers == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: supervised shrink with real training workers
+# ---------------------------------------------------------------------------
+
+def _run_chaos(tmp_path, extra):
+    cmd = [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+           "--workdir", str(tmp_path)] + extra
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    env.pop("PADDLE_TPU_LOST_DEVICES", None)
+    env["PADDLE_TPU_MAX_RESTARTS"] = "0"
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                         env=env)
+    assert out.returncode == 0, (out.stdout, out.stderr[-3000:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_chaos_shrink_e2e(tmp_path):
+    """2 workers, the highest rank permanently lost mid-run: the
+    supervisor records health.mesh_shrunk, the surviving rank finishes
+    every step on the shrunk gang, and its trajectory is bit-exact with
+    the fault-free reference."""
+    verdict = _run_chaos(tmp_path, [
+        "--shrink", "--nproc", "2", "--steps", "20",
+        "--started_port", "6501"])
+    assert verdict["ok"], verdict
+    assert verdict["shrinks"] == 1 and verdict["final_nproc"] == 1
+    assert "health.mesh_shrunk" in verdict["recovery_events"]
+
+
+@pytest.mark.slow
+def test_chaos_quorum_restore_e2e(tmp_path):
+    """disk_fail wipes rank 0's checkpoint root, a later kill forces a
+    restore — which must come from the PEER rank's replica (the sinks
+    record ckpt.quorum_restore) and still reach fault-free parity."""
+    verdict = _run_chaos(tmp_path, [
+        "--nproc", "2", "--steps", "20", "--ckpt-replicas", "1",
+        "--spec", "disk_fail@rank0:step12;worker_kill@rank0:step14",
+        "--max-restarts", "2", "--started_port", "6521"])
+    assert verdict["ok"], verdict
+    assert "ckpt.quorum_restore" in verdict["recovery_events"]
+    assert "ckpt.root_poisoned" in verdict["recovery_events"]

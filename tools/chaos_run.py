@@ -25,6 +25,10 @@ Usage::
     python tools/chaos_run.py --hang --nproc 2        # heartbeat watchdog
     python tools/chaos_run.py --dispatch-steps 8 --nproc 1 \
         --spec 'step_nan@12'   # fault lands mid async dispatch window
+    python tools/chaos_run.py --shrink --nproc 2      # permanent loss:
+        # the highest rank exits LOST mid-run, the supervisor shrinks
+        # the gang (health.mesh_shrunk) and the SURVIVORS finish all
+        # steps with fault-free parity
 
 CPU-only by construction (workers force JAX_PLATFORMS=cpu); the point
 is recovery-path coverage, not throughput.
@@ -84,7 +88,7 @@ def batch_fn(step, batch=16, seed=0):
 
 
 def train_losses(n_steps, ckpt_root, rank=0, max_rollbacks=8,
-                 on_step=None, dispatch_steps=1):
+                 on_step=None, dispatch_steps=1, replica_roots=None):
     """Train the probe model under a ResilientDriver; returns the
     per-step scalar losses. Faults (if any are scheduled) fire through
     the engine's real seams; recovery is the driver's problem.
@@ -107,7 +111,8 @@ def train_losses(n_steps, ckpt_root, rank=0, max_rollbacks=8,
     scope = fluid.global_scope()
     for k, v in init.items():
         scope.set(k, v)
-    mgr = CheckpointManager(ckpt_root, max_to_keep=4)
+    mgr = CheckpointManager(ckpt_root, max_to_keep=4,
+                            replica_roots=replica_roots)
     drv = ResilientDriver(exe, main, [loss], mgr, scope=scope,
                           ckpt_interval=CKPT_INTERVAL,
                           max_rollbacks=max_rollbacks)
@@ -148,8 +153,26 @@ def run_worker(args):
     import numpy as np
 
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     root = os.environ.get("PADDLE_TPU_RECOVERY_CKPT") or os.path.join(
         args.result_dir, "ckpt")
+    # elastic: a respawned worker inherits the supervisor's shrink count
+    # and gives up on one virtual device per shrink — mesh_from_flag
+    # then re-plans its dp=-1 axis over the survivors (the in-process
+    # half of the capacity loss the gang shrink is the process half of)
+    shrinks = int(os.environ.get("PADDLE_TPU_SHRINK_COUNT", "0"))
+    if args.mesh and shrinks:
+        from paddle_tpu.resilience import elastic
+
+        for i in range(min(shrinks, 1)):     # 2 devices: 1 can go
+            elastic.mark_device_lost(2 - 1 - i)
+    # checkpoint quorum: with PADDLE_TPU_CKPT_REPLICAS > 0 each rank
+    # mirrors its shards into its PEERS' roots, so a dead local disk
+    # (disk_fail) restores from a surviving replica
+    replica_roots = None
+    if int(os.environ.get("PADDLE_TPU_CKPT_REPLICAS", "0") or 0) > 0:
+        replica_roots = [os.path.join(root, "rank%d" % r)
+                         for r in range(nproc) if r != rank]
     # stream every step's loss to an append-only per-rank JSONL: a
     # killed incarnation's in-memory results die with it, but this file
     # survives the respawn, so the full trajectory reassembles
@@ -185,7 +208,8 @@ def run_worker(args):
 
         train_losses(args.steps, os.path.join(root, "rank%d" % rank),
                      rank=rank, on_step=on_step,
-                     dispatch_steps=args.dispatch_steps)
+                     dispatch_steps=args.dispatch_steps,
+                     replica_roots=replica_roots)
         _flush(force=True)   # train() drained the window; all resolved
     losses = reassemble_steps(steps_path, args.steps)
     if losses is None:
@@ -209,8 +233,16 @@ def run_supervisor(args):
     flags.set_flags({"metrics": True})
     kinds = (("worker_hang", "step_nan") if args.hang
              else ("worker_kill", "step_nan"))
-    spec = args.spec if args.spec is not None else random_spec(
-        args.seed, args.steps, nproc=args.nproc, kinds=kinds)
+    if args.spec is not None:
+        spec = args.spec
+    elif args.shrink:
+        # permanent loss of the HIGHEST rank (survivor ranks then keep
+        # their ids — and their checkpoint roots — across the shrink)
+        spec = "worker_loss@rank%d:step%d" % (
+            args.nproc - 1, max(2, args.steps // 2))
+    else:
+        spec = random_spec(args.seed, args.steps, nproc=args.nproc,
+                           kinds=kinds)
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_run_")
     result_dir = os.path.join(workdir, "results")
     ckpt_dir = os.path.join(workdir, "ckpt")
@@ -224,11 +256,15 @@ def run_supervisor(args):
     max_restarts = args.max_restarts if args.max_restarts is not None \
         else max(2, spec.count("worker_kill")
                  + spec.count("worker_hang") + 1)
+    max_shrinks = args.max_shrinks if args.max_shrinks is not None \
+        else spec.count("worker_loss")
     env_extra = {
         "PADDLE_TPU_FAULT_SPEC": spec,
         "PADDLE_TPU_METRICS": "1",
         "PADDLE_TPU_METRICS_SINK": sink,
     }
+    if args.ckpt_replicas:
+        env_extra["PADDLE_TPU_CKPT_REPLICAS"] = str(args.ckpt_replicas)
     worker_cmd = [os.path.abspath(__file__), "--worker",
                   "--steps", str(args.steps), "--result-dir", result_dir]
     if args.dispatch_steps > 1:
@@ -245,21 +281,28 @@ def run_supervisor(args):
         env_extra["PADDLE_TPU_MESH"] = "dp=-1"
         env_extra["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
         worker_cmd.append("--mesh")
+    stats = {}
     rc = supervise(worker_cmd, nproc=args.nproc, env_extra=env_extra,
                    max_restarts=max_restarts, recovery_dir=ckpt_dir,
                    started_port=args.started_port,
                    heartbeat_ms=args.heartbeat_ms,
-                   hang_timeout_s=args.hang_timeout)
+                   hang_timeout_s=args.hang_timeout,
+                   max_shrinks=max_shrinks, stats=stats)
     obs.detach_sink()
 
+    final_nproc = stats.get("final_nproc", args.nproc)
     verdict = {"spec": spec, "rc": rc, "workdir": workdir,
                "restarts": obs.snapshot()["counters"].get(
-                   "recovery.restart", 0)}
+                   "recovery.restart", 0),
+               "shrinks": stats.get("shrinks", 0),
+               "final_nproc": final_nproc}
     problems = []
     if rc != 0:
         problems.append("gang failed with rc %s" % rc)
+    # after a shrink only the SURVIVING ranks owe a full trajectory —
+    # the lost rank is permanently gone by design
     ranks = {}
-    for r in range(args.nproc):
+    for r in range(final_nproc):
         path = os.path.join(result_dir, "rank%d.json" % r)
         try:
             with open(path) as f:
@@ -281,9 +324,13 @@ def run_supervisor(args):
                     ev = json.loads(line)
                 except ValueError:
                     continue
-                if str(ev.get("name", "")).startswith(
-                        ("recovery.", "faultinject", "health.")):
-                    recoveries.append(ev.get("name"))
+                name = str(ev.get("name", ""))
+                if name.startswith(("recovery.", "faultinject",
+                                    "health.", "ckpt.")) \
+                        and name != "ckpt.snapshot":
+                    # ckpt.snapshot is routine save traffic, not an
+                    # incident; the quorum/replica/poison events are
+                    recoveries.append(name)
     verdict["recovery_events"] = sorted(set(recoveries))
     if spec and not recoveries and verdict["restarts"] == 0:
         problems.append("no recovery events recorded for spec %r" % spec)
@@ -293,6 +340,15 @@ def run_supervisor(args):
         # data, not merely survived by accident
         problems.append("spec injected worker_hang but the supervisor "
                         "never recorded health.hang_detected")
+    if args.shrink:
+        # the acceptance bar: the loss must have been ACTED on — the
+        # supervisor recorded the shrink and the gang really is smaller
+        if "health.mesh_shrunk" not in verdict["recovery_events"]:
+            problems.append("--shrink but the supervisor never recorded "
+                            "health.mesh_shrunk")
+        if final_nproc >= args.nproc:
+            problems.append("--shrink but the gang never shrank "
+                            "(final nproc %d)" % final_nproc)
     if args.check_parity and not problems:
         import numpy as np
 
@@ -330,6 +386,20 @@ def main():
                         help="explicit fault spec; overrides --seed")
     parser.add_argument("--max-restarts", type=int, default=None,
                         help="default: worker kills/hangs in the spec + 1")
+    parser.add_argument("--shrink", action="store_true",
+                        help="inject a PERMANENT worker loss (rc 45) on "
+                             "the highest rank mid-run: the supervisor "
+                             "must shrink the gang and the survivors "
+                             "must finish every step with fault-free "
+                             "parity")
+    parser.add_argument("--max-shrinks", type=int, default=None,
+                        help="elastic shrink budget for the supervisor "
+                             "(default: worker_loss entries in the spec)")
+    parser.add_argument("--ckpt-replicas", type=int, default=0,
+                        help="mirror each rank's checkpoint shards into "
+                             "this many PEER ranks' roots (quorum "
+                             "restore coverage; pairs with a disk_fail "
+                             "spec entry)")
     parser.add_argument("--hang", action="store_true",
                         help="seeded spec injects worker_hang instead of "
                              "worker_kill — exercises the heartbeat "

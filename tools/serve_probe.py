@@ -29,6 +29,9 @@ Usage:
   python tools/serve_probe.py --model resnet50 --no-int8 --duration 3
   python tools/serve_probe.py --qps 4,8 --slo-ms 100 --slo-floor-qps 4
   python tools/serve_probe.py --qps 8 --check-health   # readiness flip
+  python tools/serve_probe.py --autoscale              # elastic fleet:
+      # spike trips the fast burn window, the FleetRouter scales out
+      # before the slow window confirms, p99 recovers, nothing dropped
 """
 
 import argparse
@@ -92,7 +95,7 @@ def _build(model, seed):
 
 
 def build_server(model="mlp", int8=True, calib_batches=4, buckets=None,
-                 max_wait_ms=None, seed=0, slo_ms=None):
+                 max_wait_ms=None, seed=0, slo_ms=None, slo_monitor=None):
     """Freeze (+quantize) the model and wrap it in an InferenceServer
     (not yet started). Returns (server, one_row_fn, build_info)."""
     import numpy as np
@@ -129,7 +132,7 @@ def build_server(model="mlp", int8=True, calib_batches=4, buckets=None,
     server = InferenceServer(program, feed_names, fetch_names, scope=scope,
                              executor=exe, buckets=buckets,
                              max_wait_ms=max_wait_ms, name="probe",
-                             slo_ms=slo_ms)
+                             slo_ms=slo_ms, slo_monitor=slo_monitor)
     return server, one_row, info
 
 
@@ -241,6 +244,155 @@ def _fmt(v):
     return "%.2f" % v if isinstance(v, (int, float)) else "-"
 
 
+def probe_autoscale(args):
+    """Elastic-serving acceptance gate (--autoscale): a FleetRouter over
+    per-worker InferenceServers must scale OUT on a load spike's FAST
+    burn window — while the SLOW window is still under its threshold,
+    i.e. before the incident would page — and p99 must return under the
+    SLO on the grown fleet without dropping a single request.
+
+    Timeline: calibrate a baseline p50 on the 1-worker fleet and derive
+    the SLO from it (unless --serving-slo-ms pins one), run a calm phase
+    (no scaling expected), then burst requests until the router reacts,
+    then a recovery phase whose p99 is the verdict. Worker SLO monitors
+    use probe-scale windows (seconds, not SRE minutes) so the whole
+    story runs in CI time.
+    """
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability.health import SloMonitor
+    from paddle_tpu.resilience.elastic import FleetRouter
+
+    obs.set_enabled(True)
+    # generous placeholder SLO during calibration; tightened (on every
+    # live monitor — slo_ms is read at record time) once measured
+    slo_holder = [args.serving_slo_ms or 10000.0]
+    monitors = []
+    one_row_holder = []
+
+    # row-at-a-time dispatch unless the caller picked buckets: the gate
+    # exercises the AUTOSCALER, and a wide-open continuous batcher on a
+    # tiny model absorbs any burst a Python driver can offer
+    buckets = args.buckets if args.buckets is not None else "1"
+
+    def factory(idx):
+        mon = SloMonitor(slo_holder[0], target=0.9, fast_window_s=1.5,
+                         slow_window_s=45.0, fast_burn=2.0, slow_burn=3.0,
+                         name="probe%d" % idx)
+        monitors.append(mon)
+        server, one_row, _ = build_server(
+            args.model, int8=args.int8, calib_batches=args.calib_batches,
+            buckets=buckets, max_wait_ms=args.max_wait_ms,
+            seed=args.seed, slo_monitor=mon)
+        server.start()
+        server.warmup(one_row())     # arrive pre-compiled
+        one_row_holder.append(one_row)
+        return server
+
+    records = []                     # (phase, latency_ms, exception)
+
+    def submit(router, phase):
+        t0 = time.monotonic()
+        fut = router.submit(one_row_holder[0]())
+
+        def _done(f, t0=t0, phase=phase):
+            records.append((phase, (time.monotonic() - t0) * 1000.0,
+                            f.exception()))
+        fut.add_done_callback(_done)
+        return fut
+
+    def p_of(phase, q):
+        lat = [l for p, l, e in records if p == phase and e is None]
+        return float(np.percentile(lat, q)) if lat else None
+
+    router = FleetRouter(factory, min_workers=1,
+                         max_workers=args.fleet_max, cooldown_s=3.0)
+    router.start(poll_interval_s=0.15)
+    try:
+        # -- calibrate: sequential requests, unloaded 1-worker fleet
+        for _ in range(30):
+            submit(router, "calib").result(timeout=60)
+        baseline_p50 = p_of("calib", 50)
+        if args.serving_slo_ms is None:
+            slo_holder[0] = max(25.0, 8.0 * baseline_p50)
+        slo_ms = slo_holder[0]
+        for m in monitors:
+            m.slo_ms = slo_ms
+        # -- calm phase: in-SLO load, scaling must hold still. This is
+        # also the slow window's base of good samples — the spike must
+        # trip the FAST window while the slow one still reads healthy,
+        # which needs a real history of met requests behind it.
+        t_end = time.monotonic() + 4.0
+        while time.monotonic() < t_end:
+            submit(router, "calm").result(timeout=60)
+            time.sleep(0.003)
+        calm_scale_outs = router.scale_outs
+        # -- spike: a sustained stream at ~2x one worker's capacity —
+        # the queue grows, completions blow the SLO, the fast window
+        # burns, and the router must react (or the deadline passes)
+        t_spike = time.monotonic()
+        reaction_s = None
+        spike_futures = []
+        deadline = t_spike + 15.0
+        gap = max(0.0005, baseline_p50 / 1000.0 / 2.0)
+        i = 0
+        while time.monotonic() < deadline:
+            spike_futures.append(submit(router, "spike"))
+            i += 1
+            if i % 20 == 0 and router.scale_outs > calm_scale_outs:
+                reaction_s = time.monotonic() - t_spike
+                break
+            time.sleep(gap)
+        burn_at_scale_out = router.last_scale_out_burn
+        for f in spike_futures:
+            f.result(timeout=120)    # queue must fully drain, no drops
+        # -- recovery: same calm load, on the grown fleet
+        t_end = time.monotonic() + 3.0
+        while time.monotonic() < t_end:
+            submit(router, "recover").result(timeout=60)
+            time.sleep(0.003)
+        fleet = router.stats()
+    finally:
+        router.stop()
+    obs.set_enabled(None)
+
+    drops = [(p, str(e)) for p, _, e in records if e is not None]
+    p99_recovered = p_of("recover", 99)
+    slow_quiet = bool(
+        burn_at_scale_out is not None
+        and burn_at_scale_out["burn_slow"]
+        < burn_at_scale_out["slow_threshold"])
+    verdict = {
+        "slo_ms": round(slo_ms, 2),
+        "baseline_p50_ms": round(baseline_p50, 2),
+        "calm_scale_outs": calm_scale_outs,
+        "scale_outs": fleet["scale_outs"],
+        "reaction_s": round(reaction_s, 2) if reaction_s else None,
+        "burn_at_scale_out": burn_at_scale_out,
+        "scaled_before_slow_window": slow_quiet,
+        "spike_p99_ms": round(p_of("spike", 99) or 0.0, 2),
+        "recovered_p99_ms": (round(p99_recovered, 2)
+                             if p99_recovered is not None else None),
+        "requests": len(records),
+        "dropped": len(drops),
+    }
+    verdict["ok"] = bool(
+        calm_scale_outs == 0
+        and fleet["scale_outs"] >= 1
+        and slow_quiet
+        and p99_recovered is not None and p99_recovered <= slo_ms
+        and not drops)
+    print("autoscale: " + json.dumps(verdict))
+    if not verdict["ok"]:
+        sys.stderr.write(
+            "serving autoscale gate failed: want a scale-out on the "
+            "fast burn window (slow window still quiet), p99 back under "
+            "%.1fms on the grown fleet, and zero drops\n" % slo_ms)
+        return 1
+    return 0
+
+
 def slo_gate(rows, slo_ms, floor_qps):
     """Highest achieved QPS among levels meeting the p99 SLO; exit-1
     verdict when it undercuts the floor."""
@@ -284,7 +436,16 @@ def main(argv=None):
                          "before load, unhealthy (burning) under an "
                          "SLO the sweep cannot meet (default "
                          "--serving-slo-ms 0.05)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="elastic-fleet gate: a FleetRouter must scale "
+                         "out on a load spike's fast burn window (slow "
+                         "window still quiet) and p99 must recover "
+                         "under the SLO with zero dropped requests")
+    ap.add_argument("--fleet-max", type=int, default=3,
+                    help="FleetRouter max_workers for --autoscale")
     args = ap.parse_args(argv)
+    if args.autoscale:
+        return probe_autoscale(args)
     if args.check_health and args.serving_slo_ms is None:
         # an SLO so tight every served request violates it: the sweep
         # load IS the injected burn
