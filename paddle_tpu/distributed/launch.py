@@ -176,8 +176,11 @@ def supervise(script_args, nproc=1, started_port=6170,
     ``PADDLE_TPU_SHRINK_COUNT`` so an elastic training script can
     re-plan its device mesh over the surviving capacity
     (resilience/elastic.py). Shrinks do not consume the restart budget.
-    ``stats`` (optional dict) receives
-    restarts/shrinks/final_nproc/lost_ranks on exit.
+    A gang exiting ``PREEMPT_EXIT_CODE`` (46 — graceful preemption: the
+    worker drained + checkpointed before dying) restarts WITHOUT
+    spending the restart budget either: preemption is scheduled
+    capacity loss, not a fault. ``stats`` (optional dict) receives
+    restarts/shrinks/preempts/final_nproc/lost_ranks on exit.
 
     Liveness: whenever a metrics sink is configured for the workers,
     heartbeats are auto-enabled (``PADDLE_TPU_HEARTBEAT_MS`` exported
@@ -190,7 +193,8 @@ def supervise(script_args, nproc=1, started_port=6170,
     from paddle_tpu import observability as obs
     from paddle_tpu.observability import health
     from paddle_tpu.observability.export import host_tagged_path
-    from paddle_tpu.resilience.faultinject import LOST_EXIT_CODE
+    from paddle_tpu.resilience.faultinject import (LOST_EXIT_CODE,
+                                                   PREEMPT_EXIT_CODE)
     from paddle_tpu.resilience.retrying import Backoff
 
     if max_restarts is None:
@@ -211,12 +215,14 @@ def supervise(script_args, nproc=1, started_port=6170,
     attempt = 0          # incarnation counter (PADDLE_TPU_RESTART_COUNT)
     restarts = 0         # spent against max_restarts
     shrinks = 0          # spent against max_shrinks
+    preempts = 0         # budget-free restarts after graceful preemption
     lost_ranks = []
 
     def _finish(rc):
         if stats is not None:
             stats.update(rc=rc, restarts=restarts, shrinks=shrinks,
-                         final_nproc=nproc, lost_ranks=list(lost_ranks))
+                         preempts=preempts, final_nproc=nproc,
+                         lost_ranks=list(lost_ranks))
         return rc
 
     while True:
@@ -241,6 +247,22 @@ def supervise(script_args, nproc=1, started_port=6170,
         rc = wait_gang(procs, monitor=monitor, result=res)
         if rc == 0:
             return _finish(0)
+        if rc == PREEMPT_EXIT_CODE and preempts < 16:
+            # graceful preemption: the worker drained its window and
+            # published a blocking checkpoint before exiting — scheduled
+            # capacity loss, not a fault, so the restart budget is NOT
+            # spent (capped so a preempt storm cannot loop forever)
+            preempts += 1
+            attempt += 1
+            obs.inc("recovery.preempt_restart")
+            obs.tracer.event("recovery.preempt_restart", attempt=attempt,
+                             preempts=preempts)
+            obs.flush_sink()
+            print("paddle_tpu.launch: gang preempted (rc %s); restarting "
+                  "without spending budget [preempt %d]" % (rc, preempts),
+                  file=sys.stderr, flush=True)
+            time.sleep(backoff.delay(0))
+            continue
         permanent = (rc == LOST_EXIT_CODE)
         if ((permanent or restarts >= max_restarts)
                 and shrinks < max_shrinks and nproc > 1):

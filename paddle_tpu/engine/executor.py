@@ -72,6 +72,12 @@ class CompiledBlock:
         # measured memory_analysis peak
         self.memory_plan = memory_plan
         self.remat_segments = remat_segments
+        # SDC sentinel (resilience/sentinel.py): when compiled with
+        # sdc=True the jitted step returns one extra uint32[4] digest
+        # fetch and grad fetches ride behind the user fetch_list;
+        # sdc_band is the per-executable EWMA band of the digest abs-sum
+        self.sdc = False
+        self.sdc_band = None
 
 
 class Engine:
@@ -96,6 +102,10 @@ class Engine:
         # their fetches; the window retires the oldest step once depth
         # is exceeded, sync() drains it, discard() drops it (rollback).
         self.window = DispatchWindow()
+        # SDC sentinel state (resilience/sentinel.py), created lazily on
+        # the first PADDLE_TPU_SDC step: retained replay records + the
+        # observe/recover seam entry points.
+        self.sentinel = None
         # Debug guard (reference: FLAGS_check_nan_inf,
         # framework/operator.cc:972-982): verify every fetch and persisted
         # state tensor is finite after each step. Whole-step granularity —
@@ -143,8 +153,27 @@ class Engine:
     def discard_window(self):
         """Drop the in-flight window without materializing or raising —
         the rollback path (stale deferred verdicts from a faulted window
-        must not re-raise after the state was restored)."""
+        must not re-raise after the state was restored). Sentinel replay
+        records are dropped too: after a rollback/adoption the retained
+        state references no longer describe the live scope."""
+        if self.sentinel is not None:
+            self.sentinel.discard()
         return self.window.discard()
+
+    def _sdc(self):
+        if self.sentinel is None:
+            from paddle_tpu.resilience.sentinel import StepSentinel
+
+            self.sentinel = StepSentinel()
+        return self.sentinel
+
+    def sdc_recover(self, step, reason=None):
+        """Deterministic re-execution + vote for a suspect engine step
+        (resilience/sentinel.py). KeyError when no replay record is
+        retained — the caller falls back to checkpoint rollback."""
+        if self.sentinel is None:
+            raise KeyError(step)
+        return self.sentinel.recover(step, reason=reason)
 
     def _run_block_impl(
         self,
@@ -176,13 +205,22 @@ class Engine:
         if obs.enabled():
             obs.inc("engine.feed_bytes",
                     sum(int(getattr(v, "nbytes", 0)) for v in feed_values))
+        from paddle_tpu import flags as _flags
+
+        sdc = bool(_flags.get_flag("sdc")) and not is_test
+        if sdc:
+            # The sentinel's replay re-invokes the SAME executable on the
+            # retained pre-step arguments; those must stay alive after
+            # the step, so donation is off under SDC (keyed into the
+            # executable cache — toggling the flag never aliases).
+            donate_state = False
         compiled = self.get_compiled(
             program_desc, block_idx, feed_names, feed_values, fetch_list,
             is_test, donate_state, amp, accumulate_steps,
             cache_key_extra=cache_key_extra, mesh=mesh,
             shard_rules=shard_rules, data_axes=data_axes,
             remat_segments=remat_segments, verify=verify,
-            opt_level=opt_level)
+            opt_level=opt_level, sdc=sdc)
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
@@ -262,6 +300,15 @@ class Engine:
                                                  readonly, rng_seed)
         compiled.run_count += 1
 
+        sdc_probe = None
+        digest_dev = None
+        if sdc:
+            # pop the fused in-graph digest (always the LAST output)
+            # BEFORE any seam-level corruption can touch the list: the
+            # digest must reflect what the device computed inside the jit
+            fetches = list(fetches)
+            digest_dev = fetches.pop()
+
         if faultinject.active():
             # step-seam fault points (one env read when no spec is set):
             # step_fail raises out of the step; step_nan multiplies the
@@ -271,6 +318,29 @@ class Engine:
             if faultinject.fault_point("step_nan", step=self._run_counter):
                 fetches = [_poison_nan(v) for v in fetches]
                 state_out = [_poison_nan(v) for v in state_out]
+            # bitflip: SILENT corruption of the stored updated params —
+            # one mantissa bit, no exception, no NaN. Exactly what the
+            # sentinel exists to catch; with PADDLE_TPU_SDC off it goes
+            # undetected by design (that is the failure being modeled).
+            entry = faultinject.fault_point("bitflip",
+                                            step=self._run_counter)
+            if entry:
+                from paddle_tpu.resilience import sentinel as _sentinel
+
+                state_out = _sentinel.apply_bitflip(
+                    list(state_out),
+                    list(compiled.block_program.state_out_names), entry)
+
+        if sdc:
+            # dispatched NOW (eager device reductions over the seam
+            # arrays + per-replica shard checksums), compared at retire:
+            # composes with the dispatch window like the nan/inf probes
+            sdc_probe = self._sdc().observe(
+                step=self._run_counter, compiled=compiled,
+                digest=digest_dev,
+                state_out=list(state_out), user_fetches=list(fetches),
+                args=(feed_values, mutated, readonly, rng_seed),
+                writeback=state_writeback, scope=scope, mesh=mesh)
 
         if obs.enabled():
             if first:
@@ -352,13 +422,20 @@ class Engine:
             record = _StepRecord(
                 step=self._run_counter, fetch_names=list(fetch_list),
                 fetches=list(fetches), probes=probes,
-                return_numpy=return_numpy)
+                return_numpy=return_numpy, sentinel=sdc_probe)
             record.placeholders = tuple(
                 DeferredFetch(self.window, record, i, name=n)
                 for i, n in enumerate(record.fetch_names))
             obs.health.note_step_enqueued()
             self.window.push(record, depth=dispatch_steps)
             return list(record.placeholders)
+
+        if sdc_probe is not None:
+            # synchronous path: the digest verdict surfaces here, after
+            # the state write-back (an SDCSuspect's recovery replaces the
+            # suspect scope state wholesale, so ordering is safe) and
+            # after check_nan_inf (a NaN blow-up keeps its own verdict)
+            sdc_probe.check()
 
         if return_numpy:
             # one batched host transfer for all fetches (device_get on the
@@ -395,7 +472,7 @@ class Engine:
                      fetch_list, is_test, donate_state, amp,
                      accumulate_steps, cache_key_extra=None, mesh=None,
                      shard_rules=None, data_axes=("dp",), remat_segments=0,
-                     verify=None, opt_level=None):
+                     verify=None, opt_level=None, sdc=False):
         """LRU-cached executable lookup/compile for one (program, feed
         signature) — shared by ``run_block`` and the Executor's
         ``cost_analysis`` so an analysis compiles exactly the executable
@@ -443,6 +520,7 @@ class Engine:
             opt_level,
             mesh_key,
             mem_budget,
+            sdc,
         )
         compiled = self._cache.get(key)
         if compiled is None:
@@ -536,7 +614,7 @@ class Engine:
                             data_axes=data_axes, amp=amp,
                             accumulate_steps=accumulate_steps,
                             remat_segments=remat_segments or auto_remat,
-                            memory_plan=memory_plan,
+                            memory_plan=memory_plan, sdc=sdc,
                         )
                     except NotImplementedError:
                         # the remat lowering statically rejects some
@@ -555,7 +633,7 @@ class Engine:
                             data_axes=data_axes, amp=amp,
                             accumulate_steps=accumulate_steps,
                             remat_segments=remat_segments,
-                            memory_plan=memory_plan,
+                            memory_plan=memory_plan, sdc=sdc,
                         )
             self._cache[key] = compiled
             while len(self._cache) > self._cache_capacity:
@@ -581,7 +659,7 @@ class Engine:
     def _compile(self, block, feed_names, fetch_list, is_test, donate_state,
                  mesh=None, feed_values=None, shard_rules=None,
                  data_axes=("dp",), amp=False, accumulate_steps=1,
-                 remat_segments=0, memory_plan=None):
+                 remat_segments=0, memory_plan=None, sdc=False):
         if accumulate_steps > 1 and remat_segments:
             raise NotImplementedError(
                 "accumulate_steps and remat_segments cannot combine yet; "
@@ -597,7 +675,25 @@ class Engine:
                 for op in block.ops
                 if op.attrs.get("__is_loss_grad__")
                 for n in op.output_arg_names() if n.endswith("@GRAD"))
-        bp = BlockProgram(block, feed_names, fetch_list, (),
+        sdc_grad_names = []
+        if sdc and accumulate_steps <= 1 and not remat_segments:
+            # Fetch the parameter gradients alongside the user fetches so
+            # the in-graph digest covers them AND the seam can recompute
+            # the same digest eagerly over the materialized arrays. Under
+            # the scan/remat lowerings grad fetches are not supported, so
+            # the digest degrades to updated-params-only there.
+            seen = set(fetch_list)
+            for op in block.ops:
+                for n in op.output_arg_names():
+                    if not n.endswith("@GRAD") or n in seen:
+                        continue
+                    base = block.find_var_recursive(n[: -len("@GRAD")])
+                    if base is not None and getattr(base, "is_parameter",
+                                                    False):
+                        seen.add(n)
+                        sdc_grad_names.append(n)
+        bp = BlockProgram(block, feed_names,
+                          list(fetch_list) + sdc_grad_names, (),
                           extra_live_vars=extra_live)
         if accumulate_steps > 1:
             from paddle_tpu.engine.lowering import lower_block_accumulated
@@ -646,7 +742,25 @@ class Engine:
             from paddle_tpu.parallel.mesh import spmd_lowering
 
             with spmd_lowering(mesh, data_axes):
-                return fn(feed_values, state_values, rng_key)
+                fetches, state_out = fn(feed_values, state_values, rng_key)
+                if sdc:
+                    # fuse the step digest INTO the executable: abs-sum +
+                    # finite-count over (param grads, updated state) plus
+                    # an order-independent uint32 checksum over the
+                    # updated state, one extra uint32[4] fetch. The grad
+                    # fetches exist only as digest operands — they are
+                    # dropped here, so XLA never materializes them as
+                    # outputs. Pure observation — no operand of the step
+                    # reads the digest, so the computed trajectory is
+                    # bit-identical with the sentinel on or off.
+                    from paddle_tpu.resilience.sentinel import graph_digest
+
+                    n_grads = len(fetches) - len(fetch_list)
+                    digest = graph_digest(
+                        list(fetches[len(fetch_list):]) + list(state_out),
+                        exact_start=n_grads)
+                    fetches = list(fetches[:len(fetch_list)]) + [digest]
+                return fetches, state_out
 
         donate = (1,) if (donate_state and mutated) else ()
         jit_kwargs = {}
@@ -671,7 +785,8 @@ class Engine:
             # can alias the loss fetch), and a donated-AUTO input may not
             # alias a fixed-layout output; host reads are layout-agnostic
             jit_kwargs["out_shardings"] = (
-                [fmt] * len(bp.fetch_names),
+                [fmt] * (len(bp.fetch_names) - len(sdc_grad_names)
+                         + (1 if sdc else 0)),
                 [fmt] * len(bp.state_out_names),
             )
         if mesh is not None:
@@ -719,16 +834,25 @@ class Engine:
                 [state_sharding(n) for n in readonly],
                 rep,
             )
+            # the sdc digest rides as one extra replicated fetch (and
+            # the grad digest operands are never outputs)
             jit_kwargs["out_shardings"] = (
-                [rep] * len(bp.fetch_names),
+                [rep] * (len(bp.fetch_names) - len(sdc_grad_names)
+                         + (1 if sdc else 0)),
                 [state_sharding(n) for n in bp.state_out_names],
             )
         jitted = jax.jit(wrapped, donate_argnums=donate, **jit_kwargs)
         in_sh = (tuple(jit_kwargs["in_shardings"][:3])
                  if "in_shardings" in jit_kwargs else None)
-        return CompiledBlock(bp, jitted, mutated, readonly,
-                             in_shardings=in_sh, memory_plan=memory_plan,
-                             remat_segments=remat_segments)
+        cb = CompiledBlock(bp, jitted, mutated, readonly,
+                           in_shardings=in_sh, memory_plan=memory_plan,
+                           remat_segments=remat_segments)
+        if sdc:
+            from paddle_tpu.resilience.sentinel import EWMABand
+
+            cb.sdc = True
+            cb.sdc_band = EWMABand()
+        return cb
 
 
 def _poison_nan(val):

@@ -112,9 +112,10 @@ class _StepRecord:
 
     __slots__ = ("step", "fetch_names", "fetches", "probes",
                  "return_numpy", "enqueued_at", "placeholders",
-                 "resolved", "values", "discarded")
+                 "resolved", "values", "discarded", "sentinel")
 
-    def __init__(self, step, fetch_names, fetches, probes, return_numpy):
+    def __init__(self, step, fetch_names, fetches, probes, return_numpy,
+                 sentinel=None):
         self.step = step
         self.fetch_names = fetch_names
         self.fetches = fetches          # in-flight device arrays
@@ -125,6 +126,9 @@ class _StepRecord:
         self.resolved = False
         self.values = None
         self.discarded = False
+        # deferred SDC digest verdict (resilience/sentinel.py
+        # SentinelProbe) — dispatched at enqueue, checked at retire
+        self.sentinel = sentinel
 
 
 class DeferredFetch:
@@ -240,6 +244,7 @@ class DispatchWindow:
             rec.discarded = True
             rec.fetches = None
             rec.probes = None
+            rec.sentinel = None
             obs.health.note_step_retired()
             n += 1
         if n:
@@ -301,6 +306,12 @@ class DispatchWindow:
                 "FLAGS_check_nan_inf, framework/operator.cc:972)"
                 % (p.kind, p.name, p.shape, p.dtype, n_nan, n_inf,
                    rec.step))
+        sentinel, rec.sentinel = rec.sentinel, None
+        if sentinel is not None:
+            # deferred SDC verdict, after the nan/inf probes (a NaN
+            # blow-up keeps its own exception contract): an SDCSuspect
+            # raised here names the ORIGINAL step via the probe
+            sentinel.check()
 
 
 # -- input prefetch ----------------------------------------------------------

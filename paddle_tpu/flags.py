@@ -190,6 +190,38 @@ DEFS = {
         "restore() falls back to a peer's byte-identical replica when "
         "the local root is gone or poisoned (disk_fail). 0 = off "
         "(single-root behavior, exactly as before)."),
+    "sdc": (
+        bool, False,
+        "Silent-data-corruption sentinel (resilience/sentinel.py): fuse "
+        "a per-step digest (abs-sum + finite-count + order-independent "
+        "uint32 checksum over gradients and updated params) into the "
+        "jitted step as one extra fetch, recompute it eagerly at the "
+        "engine seam, and raise SDCSuspect at that step's retire when "
+        "the two disagree, replicas disagree under a dp mesh, or the "
+        "abs-sum leaves the seeded EWMA band. The ResilientDriver "
+        "replays the suspect step bit-exactly from retained inputs and "
+        "votes: transient / genuine anomaly / blamed device (which is "
+        "quarantined via elastic.mark_device_lost). Off = zero new ops "
+        "in the compiled step."),
+    "sdc_band": (
+        float, 12.0,
+        "EWMA band width of the sentinel's statistical tier: a step's "
+        "digest abs-sum is suspect when it deviates from the running "
+        "EWMA mean by more than sdc_band * ewma_stddev + 0.25 * |mean|. "
+        "The band only catches gross corruption; single-bit flips are "
+        "caught by the exact checksum / replica-vote tiers."),
+    "sdc_warmup": (
+        int, 20,
+        "Steps per compiled executable before the sentinel's EWMA band "
+        "starts flagging (the exact-checksum and replica tiers are "
+        "active from step 1; warmup only gates the statistical tier "
+        "while the gradient-scale statistics settle)."),
+    "sdc_retain": (
+        int, 12,
+        "How many recent steps the sentinel retains replay records for "
+        "(inputs + rng seed + donated-state snapshot references). Must "
+        "cover the dispatch window depth, or a deferred suspect cannot "
+        "be replayed and the driver falls back to checkpoint rollback."),
     "lost_devices": (
         str, "",
         "Comma-separated device ids the elastic layer treats as "

@@ -17,7 +17,11 @@ Four pieces:
 * ``elastic``     — acting on permanent loss WITHOUT losing the job:
   the lost-device registry ``dp=-1`` meshes re-plan over, the
   ``LOST_EXIT_CODE`` the supervisor's gang-shrink path keys on, and
-  the SLO-burn-driven serving ``FleetRouter``.
+  the SLO-burn-driven serving ``FleetRouter``;
+* ``sentinel``    — the silent-data-corruption defense
+  (``PADDLE_TPU_SDC``): in-graph step digests at the engine seam,
+  replica voting under a dp mesh, deterministic re-execution of
+  suspect steps, and device quarantine through the elastic registry.
 
 The supervised elastic launcher lives in ``distributed/launch.py``
 (it IS the launcher, grown a supervisor) and reads
@@ -30,6 +34,7 @@ from paddle_tpu.resilience import (  # noqa: F401
     elastic,
     faultinject,
     retrying,
+    sentinel,
 )
 from paddle_tpu.resilience.driver import (  # noqa: F401
     FaultBudgetExceeded,
@@ -43,8 +48,14 @@ from paddle_tpu.resilience.elastic import (  # noqa: F401
 )
 from paddle_tpu.resilience.faultinject import (  # noqa: F401
     LOST_EXIT_CODE,
+    PREEMPT_EXIT_CODE,
     InjectedFault,
     fault_point,
+)
+from paddle_tpu.resilience.sentinel import (  # noqa: F401
+    SDCBlamed,
+    SDCSuspect,
+    StepSentinel,
 )
 from paddle_tpu.resilience.retrying import (  # noqa: F401
     Backoff,
@@ -55,8 +66,9 @@ from paddle_tpu.resilience.retrying import (  # noqa: F401
 
 __all__ = [
     "Backoff", "DeadlineExceeded", "FaultBudgetExceeded", "FleetRouter",
-    "InjectedFault", "LOST_EXIT_CODE", "ResilientDriver",
-    "RetriesExhausted", "driver", "elastic", "fault_point", "faultinject",
+    "InjectedFault", "LOST_EXIT_CODE", "PREEMPT_EXIT_CODE",
+    "ResilientDriver", "RetriesExhausted", "SDCBlamed", "SDCSuspect",
+    "StepSentinel", "driver", "elastic", "fault_point", "faultinject",
     "mark_device_lost", "reset_lost", "retry_call", "retrying",
-    "surviving_devices",
+    "sentinel", "surviving_devices",
 ]

@@ -19,7 +19,10 @@ incident log.
 import numpy as np
 
 from paddle_tpu import observability as obs
-from paddle_tpu.resilience.faultinject import InjectedFault, fault_point
+from paddle_tpu.resilience.faultinject import (InjectedFault,
+                                               PREEMPT_EXIT_CODE,
+                                               fault_point)
+from paddle_tpu.resilience.sentinel import SDCBlamed, SDCSuspect
 
 __all__ = ["FaultBudgetExceeded", "ResilientDriver"]
 
@@ -93,6 +96,17 @@ class ResilientDriver:
         self.max_rollbacks = int(max_rollbacks)
         self.skip_poison_batch = bool(skip_poison_batch)
         self.rollbacks = 0
+        # graceful preemption (SIGTERM or the `preempt` fault point):
+        # the loop checks this at the step seam, drains + checkpoints,
+        # then exits PREEMPT_EXIT_CODE
+        self._preempted = False
+        self._sigterm_installed = False
+        self._old_sigterm = None
+        # engine run-counter -> driver batch step, recorded BEFORE each
+        # run: an SDCSuspect names the engine step that computed the bad
+        # digest (possibly several window slots back); the driver
+        # answers in batch steps
+        self._engine_steps = {}
         if check_nan_inf:
             # the guard IS the fault detector for numeric blow-ups; the
             # driver is pointless without one, so it defaults on here
@@ -170,6 +184,147 @@ class ResilientDriver:
                   restored_step=step, reason=str(exc)[:200])
         return step
 
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        """Join the async checkpoint writer and SURFACE any error it
+        recorded. Without this, a process that exits right after a
+        ``save(blocking=False)`` silently loses the writer's failure —
+        the caller believes the final state is durable when it is not.
+        Call it (or use the driver as a context manager) after the last
+        ``train``."""
+        self.manager.wait()
+        self.manager.check_error()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+        else:
+            # still join the writer (no orphaned thread), but never mask
+            # the active exception with a background-save error
+            try:
+                self.manager.wait()
+            except Exception:
+                pass
+        return False
+
+    # -- graceful preemption ----------------------------------------------
+    def _install_sigterm(self):
+        """SIGTERM -> finish the in-flight work, checkpoint, exit
+        PREEMPT_EXIT_CODE. Main-thread only (signal module contract);
+        worker threads skip the handler and keep the fault-point path."""
+        import signal
+
+        if self._sigterm_installed:
+            return
+        try:
+            self._old_sigterm = signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: setattr(self, "_preempted", True))
+            self._sigterm_installed = True
+        except ValueError:
+            self._sigterm_installed = False
+
+    def _restore_sigterm(self):
+        import signal
+
+        if self._sigterm_installed:
+            signal.signal(signal.SIGTERM, self._old_sigterm)
+            self._sigterm_installed = False
+            self._old_sigterm = None
+
+    def _graceful_exit(self, step):
+        """The preemption protocol: drain the dispatch window so every
+        enqueued step retires (or the window is discarded if one was
+        poisoned), take a BLOCKING checkpoint, flush telemetry, exit
+        with the code the supervisor restarts without budget."""
+        obs.inc("recovery.preempted")
+        try:
+            self._drain()
+            self._save(step, blocking=True)
+        except Exception:
+            # a fault surfaced while draining: do not publish that state
+            # — the latest complete checkpoint is already durable
+            engine = getattr(self.exe, "engine", None)
+            if engine is not None and hasattr(engine, "discard_window"):
+                engine.discard_window()
+            try:
+                self.manager.wait()
+            except Exception:
+                pass
+        obs.event("recovery.preempted", step=step)
+        try:
+            obs.flush_sink()
+        except Exception:
+            pass
+        raise SystemExit(PREEMPT_EXIT_CODE)
+
+    # -- SDC recovery ------------------------------------------------------
+    def _can_quarantine(self, dev):
+        """In-process quarantine needs an elastic mesh (`dp=-1`) with a
+        survivor left after removing ``dev``; otherwise the blame
+        propagates as SDCBlamed (gang-level shrink or hard failure)."""
+        if dev is None:
+            return False
+        from paddle_tpu import flags
+
+        if "-1" not in str(flags.get_flag("mesh")):
+            return False
+        from paddle_tpu.resilience import elastic
+
+        surviving = elastic.surviving_devices()
+        return (len(surviving) > 1
+                and any(int(d.id) == int(dev) for d in surviving))
+
+    def _sdc_recover(self, exc, results, on_step):
+        """Route an SDCSuspect through the sentinel's replay vote:
+        transient/genuine re-deliver the verified step and continue from
+        the step after it; blamed quarantines the device (elastic mesh)
+        or raises SDCBlamed; a missing replay record degrades to the
+        classic checkpoint rollback. Returns the next batch step."""
+        engine = getattr(self.exe, "engine", None)
+        b = self._engine_steps.get(exc.step)
+        obs.inc("recovery.sdc_suspects")
+        try:
+            verdict = engine.sdc_recover(exc.step,
+                                         reason=getattr(exc, "reason", None))
+        except Exception:
+            # replay record evicted (window deeper than sdc_retain) or
+            # the replay itself failed: the checkpoint path still works
+            obs.inc("recovery.sdc_replay_unavailable")
+            return self._rollback(exc.step if b is None else b, exc)
+        # the window holds steps enqueued AFTER the suspect — they ran
+        # on unverified state and will be re-run; their records and the
+        # sentinel's now-stale retained inputs are dropped together
+        if engine is not None and hasattr(engine, "discard_window"):
+            engine.discard_window()
+        if verdict["kind"] == "blamed":
+            dev = verdict.get("device")
+            failed = exc.step if b is None else b
+            if self._can_quarantine(dev):
+                from paddle_tpu.resilience import elastic
+
+                elastic.mark_device_lost(dev)
+                obs.inc("recovery.sdc_quarantine")
+                obs.event("recovery.sdc_quarantine", device=int(dev),
+                          step=failed)
+                # restore + replay: the next run re-plans `dp=-1` over
+                # the survivors and reshards (elastic's shrink path)
+                return self._rollback(failed, exc)
+            raise SDCBlamed(exc.step, dev) from exc
+        if b is None:
+            # engine step unmapped (another program ran in between):
+            # the state was verified and adopted, but WHICH batch to
+            # re-deliver is unknown — rollback keeps the trajectory
+            return self._rollback(exc.step, exc)
+        results[b] = verdict["fetches"]
+        if on_step is not None:
+            on_step(b, verdict["fetches"])
+        obs.event("recovery.sdc_%s" % verdict["kind"], step=b)
+        return b + 1
+
     # -- the loop ----------------------------------------------------------
     def train(self, batch_fn, n_steps, start_step=None, on_step=None):
         """Run steps ``[start, n_steps)``; returns the per-step fetch
@@ -183,7 +338,20 @@ class ResilientDriver:
         (replays included, re-firing for the replayed steps; failed
         steps never fire). A worker that may be killed and respawned
         streams its per-step results to durable storage here — the
-        in-memory return value dies with the process."""
+        in-memory return value dies with the process.
+
+        While ``train`` runs, SIGTERM means graceful preemption: the
+        window drains, a blocking checkpoint publishes, and the process
+        exits ``PREEMPT_EXIT_CODE`` (46) — which the supervisor restarts
+        without spending restart budget. The previous handler is
+        restored on return."""
+        self._install_sigterm()
+        try:
+            return self._train_impl(batch_fn, n_steps, start_step, on_step)
+        finally:
+            self._restore_sigterm()
+
+    def _train_impl(self, batch_fn, n_steps, start_step, on_step):
         if start_step is None:
             start_step = self.resume_step()
             if start_step is not None:
@@ -210,6 +378,9 @@ class ResilientDriver:
                 # re-enters the loop like any step fault
                 try:
                     self._drain()
+                except SDCSuspect as e:
+                    step = self._sdc_recover(e, results, on_step)
+                    continue
                 except Exception as e:  # noqa: BLE001 - filtered below
                     if not _is_recoverable(e):
                         raise
@@ -223,6 +394,12 @@ class ResilientDriver:
             fault_point("worker_kill", step=step)
             fault_point("worker_hang", step=step)
             fault_point("worker_loss", step=step)
+            if fault_point("preempt", step=step):
+                # poison-style: the driver owns the graceful-exit
+                # protocol, identical to a real SIGTERM arriving here
+                self._preempted = True
+            if self._preempted:
+                self._graceful_exit(step)
             if fault_point("disk_fail", step=step):
                 # poison-style: the driver owns the checkpoint root, so
                 # IT destroys it — the dead-local-disk scenario quorum
@@ -233,10 +410,20 @@ class ResilientDriver:
                 step += 1
                 continue
             feed = batch_fn(step)
+            engine = getattr(self.exe, "engine", None)
+            if engine is not None:
+                # prospective: THIS run will be engine step counter+1
+                self._engine_steps[engine._run_counter + 1] = step
+                if len(self._engine_steps) > 128:
+                    for k in sorted(self._engine_steps)[:-64]:
+                        del self._engine_steps[k]
             try:
                 out = self.exe.run(self.program, feed=feed,
                                    fetch_list=self.fetch_list,
                                    scope=self.scope)
+            except SDCSuspect as e:
+                step = self._sdc_recover(e, results, on_step)
+                continue
             except Exception as e:  # noqa: BLE001 - filtered below
                 if not _is_recoverable(e):
                     raise
@@ -260,6 +447,9 @@ class ResilientDriver:
                 # rollback target and trap the run in a restore loop
                 try:
                     self._drain()
+                except SDCSuspect as e:
+                    step = self._sdc_recover(e, results, on_step)
+                    continue
                 except Exception as e:  # noqa: BLE001 - filtered below
                     if not _is_recoverable(e):
                         raise

@@ -23,6 +23,9 @@ Spec grammar (';'-separated entries)::
                           by default a fault does NOT re-fire after the
                           supervisor restarts the gang)
            | 'x' N      fire N times (default 1)
+           | 'dev' N    payload parameter, not a match condition: which
+                        addressable replica shard a ``bitflip`` corrupts
+                        under a mesh (default 0; ignored elsewhere)
 
 Examples: ``step_nan@7`` — poison the 7th step's outputs with NaN;
 ``worker_kill@rank1:step12`` — rank 1 hard-exits at step 12;
@@ -56,6 +59,24 @@ Registered points and what firing does:
                  checkpoint root (the ResilientDriver rmtree-s it) —
                  the dead-local-disk scenario checkpoint quorum restore
                  recovers from via a peer root's replica
+    bitflip      returns the fired entry to the engine seam, which flips
+                 ONE mantissa bit of a stored updated param
+                 (resilience/sentinel.py apply_bitflip) — silent data
+                 corruption: no exception, no NaN, nothing the nan/inf
+                 guard can see. Only the PADDLE_TPU_SDC sentinel's
+                 digest/replica/replay machinery catches it; with the
+                 sentinel off it corrupts undetected BY DESIGN. Under a
+                 mesh the flip lands on replica shard ``dev N``. An
+                 ``x1`` entry is a transient (the sentinel's bit-exact
+                 replay comes back clean); ``xN`` keeps re-firing at the
+                 replay seam — a persistently flaky core, which the
+                 replay vote blames
+    preempt      returns the fired entry to the ResilientDriver's step
+                 loop, which treats it exactly like SIGTERM: drain the
+                 dispatch window, blocking checkpoint, exit
+                 PREEMPT_EXIT_CODE — the supervisor restarts the gang
+                 WITHOUT spending restart budget (preemption is
+                 scheduled capacity loss, not a fault)
 """
 
 import os
@@ -64,22 +85,27 @@ import time
 from paddle_tpu import flags
 
 __all__ = ["InjectedFault", "FaultEntry", "FaultSchedule", "KILLED_EXIT_CODE",
-           "LOST_EXIT_CODE", "active", "fault_point", "parse_fault_spec",
-           "random_spec", "reset"]
+           "LOST_EXIT_CODE", "PREEMPT_EXIT_CODE", "active", "fault_point",
+           "parse_fault_spec", "random_spec", "reset"]
 
 KILLED_EXIT_CODE = 43
 #: a PERMANENTLY lost worker (dead host): the supervisor must shrink
 #: the gang over the survivors, not respawn this rank
 LOST_EXIT_CODE = 45
+#: a GRACEFULLY preempted worker (SIGTERM / scheduled eviction): it
+#: drained its window and checkpointed before exiting, so the
+#: supervisor restarts the gang without spending restart budget
+PREEMPT_EXIT_CODE = 46
 
-#: points that RETURN True instead of raising — the caller applies the
-#: corruption itself (the engine owns the arrays to poison; the driver
-#: owns the checkpoint root to destroy)
-POISON_POINTS = frozenset(["step_nan", "disk_fail"])
+#: points that RETURN their fired entry (truthy) instead of raising —
+#: the caller applies the corruption itself (the engine owns the arrays
+#: to poison, the driver owns the checkpoint root to destroy / the
+#: preemption protocol to run)
+POISON_POINTS = frozenset(["step_nan", "disk_fail", "bitflip", "preempt"])
 
 KNOWN_POINTS = frozenset(
     ["step_nan", "step_fail", "compile", "ckpt_write", "worker_kill",
-     "worker_hang", "worker_loss", "disk_fail"])
+     "worker_hang", "worker_loss", "disk_fail", "bitflip", "preempt"])
 
 
 class InjectedFault(RuntimeError):
@@ -93,12 +119,16 @@ class InjectedFault(RuntimeError):
 
 
 class FaultEntry:
-    def __init__(self, point, step=None, rank=None, restart=None, repeat=1):
+    def __init__(self, point, step=None, rank=None, restart=None, repeat=1,
+                 dev=None):
         self.point = point
         self.step = step
         self.rank = rank
         self.restart = 0 if restart is None else restart
         self.repeat = repeat
+        # payload, not a match condition: which replica shard a bitflip
+        # corrupts under a mesh
+        self.dev = 0 if dev is None else dev
         self.fired = 0
 
     def matches(self, step, rank, restart):
@@ -120,6 +150,8 @@ class FaultEntry:
             conds.append("restart%d" % self.restart)
         if self.repeat != 1:
             conds.append("x%d" % self.repeat)
+        if self.dev:
+            conds.append("dev%d" % self.dev)
         return self.point + ("@" + ":".join(conds) if conds else "")
 
 
@@ -141,7 +173,8 @@ def parse_fault_spec(spec):
         for cond in (tail.split(":") if tail else []):
             cond = cond.strip()
             for prefix, key in (("step", "step"), ("rank", "rank"),
-                                ("restart", "restart"), ("x", "repeat")):
+                                ("restart", "restart"), ("dev", "dev"),
+                                ("x", "repeat")):
                 if cond.startswith(prefix) and cond[len(prefix):].isdigit():
                     kw[key] = int(cond[len(prefix):])
                     break
@@ -167,8 +200,15 @@ def random_spec(seed, n_steps, nproc=1, kinds=("worker_kill", "step_nan")):
     parts = []
     for kind in kinds:
         conds = ["step%d" % rng.randint(lo, hi)]
-        if kind in ("worker_kill", "worker_hang", "worker_loss"):
+        if kind in ("worker_kill", "worker_hang", "worker_loss", "preempt",
+                    "bitflip"):
+            # liveness/silent-corruption kinds pin to ONE rank so the
+            # rest of the gang observes the event instead of sharing it
             conds.insert(0, "rank%d" % rng.randrange(nproc))
+        if kind == "bitflip":
+            # coin-flip transient (x1: the replay comes back clean) vs
+            # persistent (the replay vote must blame the core)
+            conds.append("x%d" % rng.choice((1, 9)))
         parts.append(kind + "@" + ":".join(conds))
     return ";".join(parts)
 
@@ -225,8 +265,10 @@ def active():
 
 def fault_point(name, step=None):
     """Declare one hit of fault point ``name``. Returns False when no
-    entry fires; returns True for poison-style points (caller corrupts);
-    raises InjectedFault for failure-style points; never returns for
+    entry fires; returns the fired FaultEntry (truthy) for poison-style
+    points — callers that only need a boolean keep working, the bitflip
+    seam reads the entry's ``dev``/``fired`` payload; raises
+    InjectedFault for failure-style points; never returns for
     worker_kill."""
     spec = flags.get_flag("fault_spec")
     if not spec:
@@ -263,5 +305,5 @@ def fault_point(name, step=None):
         while True:
             time.sleep(60.0)
     if name in POISON_POINTS:
-        return True
+        return entry
     raise InjectedFault(name, step)

@@ -79,11 +79,14 @@ class TestFaultPoints:
         assert entry.rank is not None and 0 <= entry.rank < 4
 
     def test_disk_fail_is_poison_style(self):
-        """disk_fail RETURNS True (the caller owns the root to wipe)
-        rather than raising, and only on its scheduled step."""
+        """disk_fail RETURNS truthy (the caller owns the root to wipe)
+        rather than raising, and only on its scheduled step. Poison
+        points return the fired ENTRY — the bitflip seam reads its
+        dev/fired payload — so callers test truthiness, not identity."""
         _arm("disk_fail@step5")
         assert fault_point("disk_fail", step=4) is False
-        assert fault_point("disk_fail", step=5) is True
+        entry = fault_point("disk_fail", step=5)
+        assert entry and entry.point == "disk_fail"
         assert fault_point("disk_fail", step=5) is False  # fired once
 
     def test_worker_loss_exit_code_reaches_supervisor(self):
@@ -389,6 +392,66 @@ class TestMeshShrinkParity:
                                 lost_at_start=(2, 3, 4, 5, 6, 7),
                                 lost_mid_run=(1,))
         assert all(np.isfinite(losses))
+
+    @needs8
+    def test_live_shrink_mid_dispatch_window(self, tmp_path):
+        """Devices die while the async dispatch window still holds
+        in-flight steps. The already-enqueued steps were computed on the
+        OLD dp=4 mesh and their deferred fetches must retire cleanly;
+        the first enqueue AFTER the loss re-plans dp=-1 over the
+        survivors and migrates the live donated state; and the shrunk-
+        mesh trajectory stays bit-exact with restore-and-replay."""
+        flags.set_flags({"mesh": "dp=-1"})
+        for d in (4, 5, 6, 7):
+            elastic.mark_device_lost(d)
+        main, startup, loss, init = _build_mlp()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for k, v in init.items():
+                scope.set(k, v)
+            _span(exe, main, loss, scope, 0, 6)  # warm, dp=4, sync
+            obs.reset()
+            obs.set_enabled(True)
+            # fill the window: four steps enqueued, none materialized
+            pend = [exe.run(main, feed=_batch(s), fetch_list=[loss],
+                            scope=scope, dispatch_steps=4)[0]
+                    for s in range(6, 10)]
+            # the loss lands MID-window: half the dp=4 mesh dies with
+            # those four steps still in flight
+            for d in (2, 3):
+                elastic.mark_device_lost(d)
+            # continuing re-plans dp=2 + reshards while the old-mesh
+            # records drain through the window
+            pend += [exe.run(main, feed=_batch(s), fetch_list=[loss],
+                             scope=scope, dispatch_steps=4)[0]
+                     for s in range(10, 16)]
+            exe.sync()
+            windowed = [float(np.asarray(v).reshape(-1)[0]) for v in pend]
+            resharded = obs.snapshot()["counters"].get(
+                "engine.state_resharded", 0)
+            assert resharded >= 1, \
+                "mid-window shrink never migrated the donated state"
+            assert all(np.isfinite(windowed))
+            # post-shrink parity: everything from here runs on dp=2
+            snap = {k: np.asarray(scope.get(k)) for k in init}
+            mgr = CheckpointManager(str(tmp_path / "ck"))
+            mgr.save(16, snap, blocking=True)
+            continued = _span(exe, main, loss, scope, 16, 22)
+        main2, startup2, loss2, init2 = _build_mlp()
+        exe2 = fluid.Executor()
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2.run(startup2)
+            got = CheckpointManager(str(tmp_path / "ck")).restore(16)
+            for k in init2:
+                scope2.set(k, got[k])
+            replayed = _span(exe2, main2, loss2, scope2, 16, 22)
+        assert continued == replayed, (
+            "post-mid-window-shrink trajectory diverged from "
+            "restore-and-replay:\ncontinued %r\nreplayed  %r"
+            % (continued, replayed))
 
 
 # ---------------------------------------------------------------------------

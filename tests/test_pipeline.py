@@ -471,9 +471,11 @@ def _drive_mlp(ckpt_root, n_steps=12, spec=None, depth=None):
             # production wires it the same way
             flags.set_flags({"dispatch_steps": depth})
         mgr = CheckpointManager(str(ckpt_root))
-        drv = ResilientDriver(exe, main, [loss], mgr, scope=scope,
-                              ckpt_interval=4)
-        results = drv.train(lambda s: _mlp_batch(s), n_steps)
+        # context manager: close() joins the async checkpoint writer and
+        # surfaces any error it recorded instead of dropping it
+        with ResilientDriver(exe, main, [loss], mgr, scope=scope,
+                             ckpt_interval=4) as drv:
+            results = drv.train(lambda s: _mlp_batch(s), n_steps)
     return [np.asarray(r[0]).tobytes() for r in results], drv
 
 

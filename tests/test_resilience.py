@@ -240,9 +240,11 @@ def _drive(ckpt_root, n_steps=12, spec=None, **drv_kw):
         if spec is not None:
             _arm(spec)
         mgr = CheckpointManager(str(ckpt_root))
-        drv = ResilientDriver(exe, main, [loss], mgr, scope=scope,
-                              ckpt_interval=4, **drv_kw)
-        results = drv.train(_batch_fn, n_steps)
+        # context manager: close() joins the async checkpoint writer and
+        # surfaces any error it recorded instead of dropping it
+        with ResilientDriver(exe, main, [loss], mgr, scope=scope,
+                             ckpt_interval=4, **drv_kw) as drv:
+            results = drv.train(_batch_fn, n_steps)
     losses = [float(np.asarray(r[0]).reshape(-1)[0]) for r in results]
     return losses, drv
 
@@ -307,12 +309,12 @@ def test_unrecoverable_error_propagates(tmp_path):
         exe.run(startup)
         for k, v in init.items():
             scope.set(k, v)
-        drv = ResilientDriver(exe, main, [loss],
-                              CheckpointManager(str(tmp_path / "u")),
-                              scope=scope)
-        with pytest.raises(RuntimeError, match="before initialization"):
-            # a missing feed is a user bug, not a fault to roll back
-            drv.train(lambda s: {"x": _batch_fn(s)["x"]}, 3)
+        with ResilientDriver(exe, main, [loss],
+                             CheckpointManager(str(tmp_path / "u")),
+                             scope=scope) as drv:
+            with pytest.raises(RuntimeError, match="before initialization"):
+                # a missing feed is a user bug, not a fault to roll back
+                drv.train(lambda s: {"x": _batch_fn(s)["x"]}, 3)
     assert drv.rollbacks == 0
 
 
@@ -334,15 +336,17 @@ def test_resume_from_latest_checkpoint(tmp_path):
         return exe, scope
 
     exe, scope = fresh_scope()
-    ResilientDriver(exe, main, [loss], CheckpointManager(str(root)),
-                    scope=scope, ckpt_interval=4).train(_batch_fn, 10)
+    with ResilientDriver(exe, main, [loss], CheckpointManager(str(root)),
+                         scope=scope, ckpt_interval=4) as first:
+        first.train(_batch_fn, 10)
 
     exe2, scope2 = fresh_scope()
     drv = ResilientDriver(exe2, main, [loss],
                           CheckpointManager(str(root)), scope=scope2,
                           ckpt_interval=4)
     assert drv.resume_step() == 10, "final checkpoint missing"
-    results = drv.train(_batch_fn, 14)
+    with drv:
+        results = drv.train(_batch_fn, 14)
     assert len(results) == 4, "resume re-ran already-completed steps"
 
 

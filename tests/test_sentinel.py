@@ -1,0 +1,397 @@
+"""SDC sentinel: in-graph step digests, replica voting, deterministic
+re-execution, and device quarantine (resilience/sentinel.py).
+
+The layers under test, bottom-up: the digest algebra (order-free
+checksum, band-vs-exact word split, the slim seam recompute), the EWMA
+statistical band, the engine seam (digest fused into the jitted step,
+probe checked at retire — synchronously or deferred through the async
+dispatch window with ORIGINAL-step attribution), the replay vote
+(transient vs persistent bitflips), and the ResilientDriver's blame
+routing (SDCBlamed off-mesh, elastic quarantine + live shrink under
+``dp=-1``). Plus the two invariants the whole feature hangs on: with
+the flag off there is NO sentinel state at all, and with it on the
+training trajectory is bit-identical to a run without it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.resilience import elastic, faultinject, sentinel
+from paddle_tpu.resilience.driver import ResilientDriver
+from paddle_tpu.resilience.faultinject import parse_fault_spec, random_spec
+from paddle_tpu.resilience.sentinel import SDCBlamed, SDCSuspect
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean_sentinel():
+    """No sdc/mesh flags, fault specs, or lost-device marks leak across
+    tests (set_flags/mark_device_lost mirror into the environment)."""
+    yield
+    obs.set_enabled(None)
+    obs.reset()
+    elastic.reset_lost()
+    for name in ("sdc", "sdc_band", "sdc_warmup", "sdc_retain",
+                 "fault_spec", "mesh", "dispatch_steps"):
+        flags.reset_flag(name)
+    faultinject.reset()
+
+
+def _arm(spec):
+    flags.set_flags({"fault_spec": spec})
+    faultinject.reset()
+
+
+def _build_mlp():
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="qw1"),
+                            bias_attr=False)
+        pred = fluid.layers.fc(input=h, size=4,
+                               param_attr=fluid.ParamAttr(name="qw2"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    init = {
+        "qw1": np.linspace(-0.4, 0.4, 8 * 16).astype(
+            np.float32).reshape(8, 16),
+        "qw2": np.linspace(0.3, -0.3, 16 * 4).astype(
+            np.float32).reshape(16, 4),
+    }
+    return main, startup, loss, init
+
+
+def _batch(step, batch=16):
+    W = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    rng = np.random.RandomState(3000 + step)
+    xv = rng.randn(batch, 8).astype(np.float32)
+    yv = np.argmax(xv @ W, 1).astype(np.int64).reshape(-1, 1)
+    return {"x": xv, "y": yv}
+
+
+# engine step numbering in these tests: the startup run is engine step 1,
+# so train batch b runs as engine step b + 2 (fault specs pin on the
+# ENGINE step; the sentinel reports in engine steps too)
+def _engine_step(batch):
+    return batch + 2
+
+
+# ---------------------------------------------------------------------------
+# digest algebra (eager, no engine)
+# ---------------------------------------------------------------------------
+
+class TestDigest:
+    def test_single_bitflip_changes_checksum(self):
+        a = np.linspace(-1.0, 1.0, 64).astype(np.float32)
+        d1 = sentinel.graph_digest([a])
+        b = a.copy()
+        b.view(np.uint32)[7] ^= np.uint32(1 << 12)  # one mantissa bit
+        d2 = sentinel.graph_digest([b])
+        assert not sentinel.digests_match(d1, d2)
+
+    def test_checksum_is_order_free(self):
+        """The additive mod-2**32 checksum must not care about element
+        order — that is what lets the fused in-graph digest and the seam
+        recompute agree bit-exactly despite different fusion contexts."""
+        a = np.linspace(-1.0, 1.0, 64).astype(np.float32)
+        d1 = sentinel.graph_digest([a])
+        d2 = sentinel.graph_digest([a[::-1].copy()])
+        assert sentinel.digests_match(d1, d2)
+
+    def test_exact_start_excludes_grads_from_checksum(self):
+        """Gradients feed the band words (abs-sum) but never the exact
+        words — the seam recompute only ever sees the updated state."""
+        s = np.linspace(-1.0, 1.0, 64).astype(np.float32)
+        g = np.ones(16, np.float32)
+        d_state = sentinel.graph_digest([s])
+        d_both = sentinel.graph_digest([g, s], exact_start=1)
+        assert sentinel.digests_match(d_state, d_both)
+        # ...but the band word DID absorb the gradient's mass
+        assert sentinel.digest_fields(d_both)[0] > \
+            sentinel.digest_fields(d_state)[0]
+
+    def test_seam_digest_agrees_on_exact_words(self):
+        s = np.linspace(-2.0, 2.0, 48).astype(np.float32)
+        fused = sentinel.graph_digest([s])
+        seam = sentinel.seam_digest([s])
+        assert sentinel.digests_match(fused, seam)
+
+    def test_non_float_values_are_skipped(self):
+        s = np.linspace(-1.0, 1.0, 32).astype(np.float32)
+        ints = np.arange(8, dtype=np.int64)
+        assert sentinel.digests_match(sentinel.graph_digest([s]),
+                                      sentinel.graph_digest([ints, s]))
+
+
+class TestEWMABand:
+    def test_flags_gross_deviation_only(self):
+        band = sentinel.EWMABand(k=12, warmup=20)
+        rng = np.random.RandomState(5)
+        for _ in range(60):
+            x = 100.0 + float(rng.randn())
+            assert not band.anomalous(x)
+            band.update(x)
+        assert band.anomalous(100.0 * 50)
+        assert not band.anomalous(101.0)
+
+    def test_warmup_never_flags(self):
+        band = sentinel.EWMABand(k=12, warmup=10)
+        band.update(1.0)
+        assert not band.anomalous(1e30)
+
+    def test_nonfinite_updates_are_dropped(self):
+        """The abs-sum word is deliberately unmasked, so a nan/inf step
+        (caught by the finite guard and rolled back) must not poison the
+        band statistics."""
+        band = sentinel.EWMABand(k=12, warmup=5)
+        band.update(float("nan"))
+        band.update(float("inf"))
+        assert band.n == 0
+        for _ in range(8):
+            band.update(1.0)
+        assert not band.anomalous(1.05)
+
+
+def test_random_spec_covers_bitflip_and_preempt():
+    """random_spec's chaos menu includes the two new kinds: bitflip is
+    rank-pinned with a transient-or-persistent repeat, preempt is
+    rank-pinned (one worker gets evicted, the gang observes it)."""
+    spec = random_spec(7, 40, nproc=4, kinds=("bitflip", "preempt"))
+    by = {e.point: e for e in parse_fault_spec(spec)}
+    assert set(by) == {"bitflip", "preempt"}
+    assert by["bitflip"].rank is not None and 0 <= by["bitflip"].rank < 4
+    assert by["bitflip"].repeat in (1, 9)
+    assert by["preempt"].rank is not None and 0 <= by["preempt"].rank < 4
+
+
+# ---------------------------------------------------------------------------
+# engine seam: fused digest, off-state, bit-identical trajectories
+# ---------------------------------------------------------------------------
+
+def _train(sdc, depth, n_steps=6):
+    flags.set_flags({"sdc": bool(sdc)})
+    main, startup, loss, init = _build_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for k, v in init.items():
+            scope.set(k, v)
+        vals = [exe.run(main, feed=_batch(s), fetch_list=[loss],
+                        scope=scope, dispatch_steps=depth)[0]
+                for s in range(n_steps)]
+        exe.sync()
+        out = [np.asarray(v).tobytes() for v in vals]
+    if not sdc:
+        # flag down -> the sentinel must not even exist (no retained
+        # inputs, no band state, no extra fetch)
+        assert exe.engine.sentinel is None
+    return out
+
+
+class TestEngineSeam:
+    def test_sdc_off_on_bit_identical_sync_and_windowed(self):
+        """The sentinel observes; it must never perturb. Digest on/off,
+        sync or through the dispatch window: same bits."""
+        ref = _train(sdc=False, depth=1)
+        assert _train(sdc=True, depth=1) == ref
+        assert _train(sdc=True, depth=4) == ref
+
+    def test_digest_deterministic_across_rejit(self):
+        """The exact digest words must survive a full re-jit (fresh
+        executor + cleared jax caches): replay voting compares digests
+        produced by different compilations of the same program."""
+        flags.set_flags({"sdc": True})
+
+        def run_once():
+            main, startup, loss, init = _build_mlp()
+            exe = fluid.Executor()
+            scope = fluid.Scope()
+            digs = []
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                for k, v in init.items():
+                    scope.set(k, v)
+                for s in range(3):
+                    exe.run(main, feed=_batch(s), fetch_list=[loss],
+                            scope=scope)
+                    rec = exe.engine.sentinel.retained[_engine_step(s)]
+                    digs.append(sentinel.digest_fields(rec.digest))
+            return digs
+
+        first = run_once()
+        jax.clear_caches()
+        sentinel._seam_digest_jit = None
+        second = run_once()
+        for a, b in zip(first, second):
+            # words [1:] (nonfinite, checksum, count) are bit-exact by
+            # construction; word [0] (float abs-sum) may legally differ
+            # in reduction order and is never compared
+            assert a[1:] == b[1:]
+
+    def test_ewma_no_false_positive_200_clean_steps_mlp(self):
+        flags.set_flags({"sdc": True})
+        obs.reset()
+        obs.set_enabled(True)
+        main, startup, loss, init = _build_mlp()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for k, v in init.items():
+                scope.set(k, v)
+            for s in range(200):
+                exe.run(main, feed=_batch(s), fetch_list=[loss],
+                        scope=scope)
+        counters = obs.snapshot()["counters"]
+        assert counters.get("sentinel.checks", 0) >= 200
+        assert counters.get("sentinel.suspects", 0) == 0
+
+    @pytest.mark.slow
+    def test_ewma_no_false_positive_200_clean_steps_bert_dropout(self):
+        """Dropout makes the step stochastic across the run — the band
+        must absorb the resulting abs-sum wander without alarming."""
+        from paddle_tpu import models
+
+        flags.set_flags({"sdc": True})
+        obs.reset()
+        obs.set_enabled(True)
+        kw = dict(d_model=32, n_layers=2, n_heads=2, d_inner=64)
+        main, startup, h = models.bert.get_model(
+            batch_size=2, seq_len=16, vocab_size=128, dropout=0.1,
+            lr=1e-3, max_position=64, **kw)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for s in range(200):
+                b = models.bert.make_fake_batch(
+                    2, 16, 128, kw["n_heads"],
+                    rng=np.random.RandomState(77 + s))
+                exe.run(main, feed=b, fetch_list=[h["loss"]])
+        counters = obs.snapshot()["counters"]
+        assert counters.get("sentinel.checks", 0) >= 200
+        assert counters.get("sentinel.suspects", 0) == 0
+
+    def test_deferred_digest_names_original_step(self):
+        """Through the async dispatch window the digest verdict retires
+        several slots after it was enqueued; the suspect must still name
+        the engine step that COMPUTED the bad number."""
+        flags.set_flags({"sdc": True})
+        bad = _engine_step(2)
+        main, startup, loss, init = _build_mlp()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for k, v in init.items():
+                scope.set(k, v)
+            _arm("bitflip@step%d" % bad)
+            caught = None
+            try:
+                for s in range(8):
+                    exe.run(main, feed=_batch(s), fetch_list=[loss],
+                            scope=scope, dispatch_steps=4)
+                exe.sync()
+            except SDCSuspect as e:
+                caught = e
+            assert caught is not None and caught.step == bad
+            # the verdict surfaced at retire, AFTER later steps had
+            # already been enqueued on top of the suspect state
+            assert exe.engine._run_counter > bad
+            exe.engine.discard_window()
+
+
+# ---------------------------------------------------------------------------
+# replay vote + driver routing
+# ---------------------------------------------------------------------------
+
+def _drive(tmp_path, sub, n_steps=8, spec=None, mesh=None):
+    """One ResilientDriver run of the probe MLP; returns (losses,
+    counters). ``spec`` arms faultinject before training."""
+    f = {"sdc": True}
+    if mesh:
+        f["mesh"] = mesh
+    flags.set_flags(f)
+    obs.reset()
+    obs.set_enabled(True)
+    main, startup, loss, init = _build_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for k, v in init.items():
+            scope.set(k, v)
+        if spec:
+            _arm(spec)
+        mgr = CheckpointManager(str(tmp_path / sub))
+        # context manager: close() joins the async checkpoint writer and
+        # surfaces any error it recorded (a silently failed final save
+        # must not report success)
+        with ResilientDriver(exe, main, [loss], mgr, scope=scope,
+                             ckpt_interval=3, max_rollbacks=4) as drv:
+            res = drv.train(_batch, n_steps)
+    losses = [float(np.asarray(r[0]).reshape(-1)[0]) for r in res]
+    return losses, obs.snapshot()["counters"]
+
+
+class TestReplayVote:
+    def test_transient_bitflip_absorbed_bit_exact(self, tmp_path):
+        """An x1 bitflip: the bit-exact replay comes back clean, the
+        verified replayed state is adopted, and the finished trajectory
+        is IDENTICAL to a fault-free run — no rollback, no lost steps."""
+        ref, _ = _drive(tmp_path, "ref")
+        got, counters = _drive(tmp_path, "flip",
+                               spec="bitflip@step%d" % _engine_step(4))
+        assert got == ref
+        assert counters.get("sentinel.bitflips_injected", 0) == 1
+        assert counters.get("sentinel.transient", 0) == 1
+        assert counters.get("recovery.sdc_suspects", 0) == 1
+        assert counters.get("recovery.rollback", 0) == 0
+
+    def test_persistent_bitflip_blamed_off_mesh(self, tmp_path):
+        """An xN entry re-fires at the replay seam (a persistently flaky
+        core): the replay vote blames, and with no shrinkable mesh the
+        driver raises SDCBlamed to the caller."""
+        with pytest.raises(SDCBlamed):
+            _drive(tmp_path, "persist",
+                   spec="bitflip@step%d:x5" % _engine_step(4))
+        counters = obs.snapshot()["counters"]
+        assert counters.get("sentinel.blamed", 0) == 1
+        assert counters.get("sentinel.transient", 0) == 0
+
+    @needs8
+    def test_replica_blame_quarantines_device_and_run_finishes(
+            self, tmp_path):
+        """The full in-process story under an elastic mesh: a persistent
+        bitflip on replica shard dev3 is blamed by the replica vote, the
+        driver quarantines device 3 through the elastic lost-device
+        registry, the live mesh re-plans dp=8 -> dp=7 (state reshards),
+        and training completes from the rollback checkpoint."""
+        n = 10
+        losses, counters = _drive(
+            tmp_path, "replica", n_steps=n, mesh="dp=-1",
+            spec="bitflip@step%d:x9:dev3" % _engine_step(5))
+        assert len(losses) == n and all(np.isfinite(losses))
+        assert counters.get("sentinel.blamed", 0) >= 1
+        assert counters.get("recovery.sdc_quarantine", 0) == 1
+        # rollback restored HOST arrays, so the shrink shows up as a
+        # re-jit under the new mesh signature (startup + dp8 main + dp7
+        # main), not as a live-state migration (test_elastic owns that)
+        assert counters.get("engine.cache_miss", 0) >= 3
+        assert counters.get("recovery.rollback", 0) == 1
+        ids = [d.id for d in elastic.surviving_devices()]
+        assert len(ids) == len(jax.devices()) - 1 and 3 not in ids

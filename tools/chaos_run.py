@@ -29,6 +29,15 @@ Usage::
         # the highest rank exits LOST mid-run, the supervisor shrinks
         # the gang (health.mesh_shrunk) and the SURVIVORS finish all
         # steps with fault-free parity
+    python tools/chaos_run.py --sdc --nproc 2         # silent corruption:
+        # a transient bitflip on rank 0 is detected at that step's
+        # retire, replayed clean, and absorbed; a PERSISTENT bitflip on
+        # the highest rank is blamed by the replay vote, the rank exits
+        # LOST, the supervisor shrinks, and the survivors finish with
+        # bit-exact fault-free parity
+    python tools/chaos_run.py --preempt --nproc 2     # graceful SIGTERM:
+        # rank 0 drains + checkpoints + exits rc 46; the supervisor
+        # restarts WITHOUT spending restart budget and the job completes
 
 CPU-only by construction (workers force JAX_PLATFORMS=cpu); the point
 is recovery-path coverage, not throughput.
@@ -113,11 +122,14 @@ def train_losses(n_steps, ckpt_root, rank=0, max_rollbacks=8,
         scope.set(k, v)
     mgr = CheckpointManager(ckpt_root, max_to_keep=4,
                             replica_roots=replica_roots)
-    drv = ResilientDriver(exe, main, [loss], mgr, scope=scope,
-                          ckpt_interval=CKPT_INTERVAL,
-                          max_rollbacks=max_rollbacks)
-    results = drv.train(lambda s: batch_fn(s, seed=rank), n_steps,
-                        on_step=on_step)
+    # context manager: close() joins the async checkpoint writer and
+    # SURFACES any error it recorded — without it a failed background
+    # save of the final state is silently lost at process exit
+    with ResilientDriver(exe, main, [loss], mgr, scope=scope,
+                         ckpt_interval=CKPT_INTERVAL,
+                         max_rollbacks=max_rollbacks) as drv:
+        results = drv.train(lambda s: batch_fn(s, seed=rank), n_steps,
+                            on_step=on_step)
     return [float(np.asarray(r[0]).reshape(-1)[0]) for r in results]
 
 
@@ -151,6 +163,9 @@ def run_worker(args):
 
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
+
+    from paddle_tpu.resilience import SDCBlamed
+    from paddle_tpu.resilience.faultinject import LOST_EXIT_CODE
 
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
@@ -206,10 +221,26 @@ def run_worker(args):
             pending.append((step, out[0]))
             _flush()
 
-        train_losses(args.steps, os.path.join(root, "rank%d" % rank),
-                     rank=rank, on_step=on_step,
-                     dispatch_steps=args.dispatch_steps,
-                     replica_roots=replica_roots)
+        try:
+            train_losses(args.steps, os.path.join(root, "rank%d" % rank),
+                         rank=rank, on_step=on_step,
+                         dispatch_steps=args.dispatch_steps,
+                         replica_roots=replica_roots)
+        except SDCBlamed as e:
+            # the sentinel's replay vote convicted OUR device of
+            # persistent silent corruption and there is no in-process
+            # spare to quarantine: flush what resolved (the discarded
+            # in-flight tail drops itself), then exit LOST so the
+            # supervisor shrinks the gang around this rank — the same
+            # path a dead host takes, because that is what we now are
+            _flush(force=True)
+            from paddle_tpu import observability as obs
+
+            # sentinel.blamed must be on disk for the verdict scan
+            obs.flush_sink()
+            print("chaos_run worker %d: %s; exiting LOST" % (rank, e),
+                  file=sys.stderr)
+            return LOST_EXIT_CODE
         _flush(force=True)   # train() drained the window; all resolved
     losses = reassemble_steps(steps_path, args.steps)
     if losses is None:
@@ -233,6 +264,11 @@ def run_supervisor(args):
     flags.set_flags({"metrics": True})
     kinds = (("worker_hang", "step_nan") if args.hang
              else ("worker_kill", "step_nan"))
+    # --sdc injects at ENGINE step numbers (the bitflip seam lives in
+    # the executor): startup run is engine step 1, batch 0 is engine
+    # step 2, so batch step b corrupts at engine step b + 2
+    sdc_transient = max(2, args.steps // 3) + 2
+    sdc_persist = max(4, args.steps // 2) + 2
     if args.spec is not None:
         spec = args.spec
     elif args.shrink:
@@ -240,6 +276,18 @@ def run_supervisor(args):
         # their ids — and their checkpoint roots — across the shrink)
         spec = "worker_loss@rank%d:step%d" % (
             args.nproc - 1, max(2, args.steps // 2))
+    elif args.sdc:
+        # one TRANSIENT flip on rank 0 (fires once; the replay is clean
+        # and the step is absorbed) plus a PERSISTENT flip on the
+        # highest rank (x9: every replay corrupts again, so the vote
+        # blames the device and the rank exits LOST)
+        spec = ("bitflip@step%d:rank0;bitflip@step%d:rank%d:x9"
+                % (sdc_transient, sdc_persist, args.nproc - 1))
+    elif args.preempt:
+        # SIGTERM-style eviction of rank 0 mid-run: the driver drains,
+        # checkpoints, and exits PREEMPT_EXIT_CODE; the supervisor
+        # restarts the gang without spending restart budget
+        spec = "preempt@step%d:rank0" % max(2, args.steps // 2)
     else:
         spec = random_spec(args.seed, args.steps, nproc=args.nproc,
                            kinds=kinds)
@@ -257,12 +305,17 @@ def run_supervisor(args):
         else max(2, spec.count("worker_kill")
                  + spec.count("worker_hang") + 1)
     max_shrinks = args.max_shrinks if args.max_shrinks is not None \
-        else spec.count("worker_loss")
+        else spec.count("worker_loss") + (1 if args.sdc else 0)
     env_extra = {
         "PADDLE_TPU_FAULT_SPEC": spec,
         "PADDLE_TPU_METRICS": "1",
         "PADDLE_TPU_METRICS_SINK": sink,
     }
+    if args.sdc:
+        # arm the sentinel in every worker: in-graph digests, replay
+        # voting, and blame are all worker-side — the supervisor only
+        # sees the resulting LOST exit
+        env_extra["PADDLE_TPU_SDC"] = "1"
     if args.ckpt_replicas:
         env_extra["PADDLE_TPU_CKPT_REPLICAS"] = str(args.ckpt_replicas)
     worker_cmd = [os.path.abspath(__file__), "--worker",
@@ -317,6 +370,7 @@ def run_supervisor(args):
     # must have been recorded there, not just survived. Per-worker
     # sinks are host-tagged (metrics.jsonl -> metrics.h<rank>.jsonl).
     recoveries = []
+    sentinel_events = []
     for path in glob.glob(os.path.splitext(sink)[0] + "*"):
         with open(path) as f:
             for line in f:
@@ -326,11 +380,13 @@ def run_supervisor(args):
                     continue
                 name = str(ev.get("name", ""))
                 if name.startswith(("recovery.", "faultinject",
-                                    "health.", "ckpt.")) \
+                                    "health.", "ckpt.", "sentinel.")) \
                         and name != "ckpt.snapshot":
                     # ckpt.snapshot is routine save traffic, not an
                     # incident; the quorum/replica/poison events are
                     recoveries.append(name)
+                if name.startswith("sentinel."):
+                    sentinel_events.append(ev)
     verdict["recovery_events"] = sorted(set(recoveries))
     if spec and not recoveries and verdict["restarts"] == 0:
         problems.append("no recovery events recorded for spec %r" % spec)
@@ -340,15 +396,58 @@ def run_supervisor(args):
         # data, not merely survived by accident
         problems.append("spec injected worker_hang but the supervisor "
                         "never recorded health.hang_detected")
-    if args.shrink:
+    if args.shrink or args.sdc:
         # the acceptance bar: the loss must have been ACTED on — the
         # supervisor recorded the shrink and the gang really is smaller
         if "health.mesh_shrunk" not in verdict["recovery_events"]:
-            problems.append("--shrink but the supervisor never recorded "
+            problems.append("the supervisor never recorded "
                             "health.mesh_shrunk")
         if final_nproc >= args.nproc:
-            problems.append("--shrink but the gang never shrank "
+            problems.append("the gang never shrank "
                             "(final nproc %d)" % final_nproc)
+    if args.sdc:
+        # the --sdc acceptance bar, end to end: the corruption must be
+        # DETECTED at the injected step's retire (not later), the
+        # replay vote must BLAME the injected rank, the transient must
+        # have been absorbed, and the survivors' parity check below
+        # proves the blamed rank's eviction cost zero trajectory drift
+        by_name = {}
+        for ev in sentinel_events:
+            by_name.setdefault(ev["name"], []).append(
+                ev.get("args") or {})
+        suspects = by_name.get("sentinel.suspect", [])
+        if not any(int(a.get("step", -1)) == sdc_persist
+                   for a in suspects):
+            problems.append(
+                "no sentinel.suspect at injected engine step %d "
+                "(suspects: %r)" % (sdc_persist, suspects))
+        blamed = by_name.get("sentinel.blamed", [])
+        if not any(int(a.get("step", -1)) == sdc_persist
+                   and int(a.get("rank", -1)) == args.nproc - 1
+                   for a in blamed):
+            problems.append(
+                "persistent bitflip on rank %d at engine step %d was "
+                "never blamed (blamed: %r)"
+                % (args.nproc - 1, sdc_persist, blamed))
+        if not by_name.get("sentinel.transient"):
+            problems.append("the transient bitflip on rank 0 was never "
+                            "absorbed (no sentinel.transient event)")
+        verdict["sentinel_events"] = sorted(by_name)
+    if args.preempt:
+        # the --preempt acceptance bar: the eviction was GRACEFUL (the
+        # driver recorded recovery.preempted before exiting 46), the
+        # supervisor took the no-budget restart path, and the restart
+        # budget is untouched
+        if "recovery.preempted" not in verdict["recovery_events"]:
+            problems.append("rank 0 never recorded recovery.preempted")
+        if "recovery.preempt_restart" not in verdict["recovery_events"]:
+            problems.append("the supervisor never recorded "
+                            "recovery.preempt_restart")
+        verdict["preempts"] = stats.get("preempts", 0)
+        if verdict["restarts"] != 0:
+            problems.append(
+                "preemption burned restart budget (recovery.restart "
+                "= %d, expected 0)" % verdict["restarts"])
     if args.check_parity and not problems:
         import numpy as np
 
@@ -394,7 +493,22 @@ def main():
                              "parity")
     parser.add_argument("--max-shrinks", type=int, default=None,
                         help="elastic shrink budget for the supervisor "
-                             "(default: worker_loss entries in the spec)")
+                             "(default: worker_loss entries in the spec, "
+                             "+1 under --sdc)")
+    parser.add_argument("--sdc", action="store_true",
+                        help="silent-data-corruption gate: workers run "
+                             "with PADDLE_TPU_SDC=1; a transient bitflip "
+                             "on rank 0 must be replay-absorbed and a "
+                             "persistent one on the highest rank must be "
+                             "blamed, quarantined via gang shrink, and "
+                             "the survivors must keep bit-exact "
+                             "fault-free parity")
+    parser.add_argument("--preempt", action="store_true",
+                        help="graceful-preemption gate: rank 0 is "
+                             "SIGTERM-evicted mid-run, must drain + "
+                             "checkpoint + exit rc 46, and the "
+                             "supervisor must restart without spending "
+                             "restart budget")
     parser.add_argument("--ckpt-replicas", type=int, default=0,
                         help="mirror each rank's checkpoint shards into "
                              "this many PEER ranks' roots (quorum "
