@@ -191,6 +191,7 @@ def supervise(script_args, nproc=1, started_port=6170,
     step-latency-EWMA auto)."""
     from paddle_tpu import flags
     from paddle_tpu import observability as obs
+    from paddle_tpu.observability import goodput as goodput_mod
     from paddle_tpu.observability import health
     from paddle_tpu.observability.export import host_tagged_path
     from paddle_tpu.resilience.faultinject import (LOST_EXIT_CODE,
@@ -217,12 +218,31 @@ def supervise(script_args, nproc=1, started_port=6170,
     shrinks = 0          # spent against max_shrinks
     preempts = 0         # budget-free restarts after graceful preemption
     lost_ranks = []
+    # Job-level goodput ledger (observability/goodput.py): gang-up
+    # intervals are goodput, the dead air between incarnations is
+    # charged to the exit path's badput category — so restart backoff,
+    # shrink re-plans, and preemption drains are never silently lost
+    # across process boundaries. Fenced by incarnation: a charge tagged
+    # with a torn-down gang's attempt is rejected, not mis-booked.
+    ledger = goodput_mod.JobLedger(attempt=0)
+    gap_since = None     # monotonic ts the last gang exited
+    gap_kind = None      # badput category for [gap_since, next launch)
 
     def _finish(rc):
+        snap = ledger.snapshot()
         if stats is not None:
             stats.update(rc=rc, restarts=restarts, shrinks=shrinks,
                          preempts=preempts, final_nproc=nproc,
-                         lost_ranks=list(lost_ranks))
+                         lost_ranks=list(lost_ranks), goodput=snap)
+        # direct tracer event: the job ledger is the incident record a
+        # fleet rollup reads, so it lands in the supervisor's sink even
+        # with metrics gated off
+        obs.tracer.event("goodput.job", rc=rc, attempt=ledger.attempt,
+                         wall_ms=round(snap["wall_ms"], 3),
+                         goodput_frac=round(snap["goodput_frac"], 6),
+                         categories={c: round(m, 3) for c, m in
+                                     snap["categories"].items()})
+        obs.flush_sink()
         return rc
 
     while True:
@@ -238,6 +258,11 @@ def supervise(script_args, nproc=1, started_port=6170,
             monitor = health.HealthMonitor(
                 {r: host_tagged_path(sink_base, r) for r in range(nproc)},
                 heartbeat_ms=hb_ms, hang_timeout_s=hang_timeout_s)
+        t_launch = time.monotonic()
+        if gap_since is not None:
+            ledger.gap(gap_kind or "restart_downtime", gap_since,
+                       t_launch, attempt=attempt)
+            gap_since = None
         procs = launch_processes(script_args, nproc, started_port,
                                  node_ip, env_extra=env,
                                  capture_output=capture_output)
@@ -245,6 +270,8 @@ def supervise(script_args, nproc=1, started_port=6170,
             on_gang(procs, attempt)
         res = {}
         rc = wait_gang(procs, monitor=monitor, result=res)
+        gap_since, gap_kind = time.monotonic(), None
+        ledger.gang(t_launch, gap_since, attempt=attempt)
         if rc == 0:
             return _finish(0)
         if rc == PREEMPT_EXIT_CODE and preempts < 16:
@@ -254,6 +281,8 @@ def supervise(script_args, nproc=1, started_port=6170,
             # spent (capped so a preempt storm cannot loop forever)
             preempts += 1
             attempt += 1
+            ledger.next_incarnation()
+            gap_kind = "preempt_drain"
             obs.inc("recovery.preempt_restart")
             obs.tracer.event("recovery.preempt_restart", attempt=attempt,
                              preempts=preempts)
@@ -273,6 +302,8 @@ def supervise(script_args, nproc=1, started_port=6170,
             nproc -= 1
             shrinks += 1
             attempt += 1
+            ledger.next_incarnation()
+            gap_kind = "shrink_rejit"
             obs.inc("health.mesh_shrunk")
             # direct tracer event: the shrink record must land in the
             # supervisor's sink even with metrics gated off
@@ -291,6 +322,8 @@ def supervise(script_args, nproc=1, started_port=6170,
         delay = backoff.delay(restarts)
         restarts += 1
         attempt += 1
+        ledger.next_incarnation()
+        gap_kind = "restart_downtime"
         obs.inc("recovery.restart")
         obs.event("recovery.restart", rc=rc, attempt=restarts,
                   backoff_s=round(delay, 3))

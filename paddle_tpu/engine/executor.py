@@ -78,6 +78,10 @@ class CompiledBlock:
         # sdc_band is the per-executable EWMA band of the digest abs-sum
         self.sdc = False
         self.sdc_band = None
+        # model FLOPs per execution from XLA's cost_analysis(), captured
+        # once at the first run (goodput ledger / MFU attribution);
+        # None until captured, 0.0 when the backend reports nothing
+        self.flops = None
 
 
 class Engine:
@@ -299,6 +303,19 @@ class Engine:
             fetches, state_out = compiled.jitted(feed_values, mutated,
                                                  readonly, rng_seed)
         compiled.run_count += 1
+
+        if obs.goodput.enabled():
+            if first:
+                # once per executable: model FLOPs from cost_analysis()
+                # (same lowering-cache retrace record_compile_memory
+                # uses), then charge the first-call wall — the honest
+                # XLA compile — to the ledger's "compile" category
+                if compiled.flops is None:
+                    compiled.flops = obs.goodput.record_compile_flops(
+                        compiled.jitted,
+                        (feed_values, mutated, readonly, rng_seed)) or 0.0
+                obs.goodput.mark("compile")
+            obs.goodput.note_flops(compiled.flops or 0.0)
 
         sdc_probe = None
         digest_dev = None
@@ -635,6 +652,10 @@ class Engine:
                             remat_segments=remat_segments,
                             memory_plan=memory_plan, sdc=sdc,
                         )
+            # the cache-miss build (trace/transform/verify/lower) is
+            # wall the step did not spend computing — charge it now so
+            # the step-boundary mark books only the remainder as compute
+            obs.goodput.mark("compile")
             self._cache[key] = compiled
             while len(self._cache) > self._cache_capacity:
                 self._cache.popitem(last=False)

@@ -259,6 +259,10 @@ class DispatchWindow:
         try:
             self._resolve(rec)
         finally:
+            # the retire is the host-sync point of the async window —
+            # the ledger charges the resolve wall as host_sync (goodput:
+            # pipeline overlap, not waste)
+            obs.goodput.mark("host_sync")
             # the step left the in-flight window whether or not its
             # deferred guard tripped — the watchdog's retired counter
             # must advance either way (the rank is not hung, it blew up)
@@ -421,6 +425,9 @@ class PrefetchingFeeder:
         if obs.enabled():
             obs.observe("pipeline.prefetch_wait_ms",
                         (time.monotonic() - t0) * 1000.0)
+        # blocked-on-input wall since the last ledger mark (queue wait
+        # plus the host-side batch handling leading into it)
+        obs.goodput.mark("input_wait")
         if isinstance(item, _End):
             self.close()
             raise StopIteration

@@ -213,13 +213,23 @@ class Executor:
         from paddle_tpu.compiler import CompiledProgram
 
         with obs.span("executor.run"):
-            return self._run_impl(
-                program=program, feed=feed, fetch_list=fetch_list,
-                scope=scope, return_numpy=return_numpy,
-                accumulate_steps=accumulate_steps,
-                remat_segments=remat_segments, verify=verify,
-                opt_level=opt_level, mesh=mesh, shard_rules=shard_rules,
-                data_axes=data_axes, dispatch_steps=dispatch_steps)
+            try:
+                return self._run_impl(
+                    program=program, feed=feed, fetch_list=fetch_list,
+                    scope=scope, return_numpy=return_numpy,
+                    accumulate_steps=accumulate_steps,
+                    remat_segments=remat_segments, verify=verify,
+                    opt_level=opt_level, mesh=mesh,
+                    shard_rules=shard_rules,
+                    data_axes=data_axes, dispatch_steps=dispatch_steps)
+            finally:
+                # goodput ledger step boundary: everything since the
+                # last seam mark (compile / input_wait / host_sync /
+                # driver charges) was forward progress — charge it as
+                # compute and refresh the goodput.*/mfu.* gauges. The
+                # widest per-step envelope, so inter-seam host work
+                # counts as compute, not idle.
+                obs.goodput.step_boundary()
 
     def _run_impl(self, program=None, feed=None, fetch_list=None,
                   scope=None, return_numpy=True, accumulate_steps=1,

@@ -107,6 +107,23 @@ DEFS = {
         "cache/compile/run counters + timing histograms and host-side "
         "spans exportable as chrome-trace JSON. Off = no-op stubs at "
         "every instrumented seam (near-zero overhead)."),
+    "goodput": (
+        bool, False,
+        "Goodput ledger (observability/goodput.py): charge every "
+        "wall-clock second of the run to one category — compute, "
+        "compile, input_wait, host_sync, ckpt_critical, rollback_replay, "
+        "restart_downtime, shrink_rejit, preempt_drain, idle — via "
+        "sequential marks at the existing engine/pipeline/driver seams; "
+        "publishes goodput.* and mfu.* gauges. Conservation (categories "
+        "sum to wall clock) holds by construction. Off = one bool check "
+        "per seam."),
+    "peak_flops": (
+        float, 0.0,
+        "Peak accelerator FLOP/s for MFU attribution (mfu.mfu = achieved "
+        "/ peak, mfu.goodput_mfu discounts badput wall). Required on CPU "
+        "probes where jax reports no peak; <=0 skips the MFU ratio "
+        "gauges (model_flops_per_step / achieved_flops_per_s still "
+        "publish)."),
     "metrics_sink": (
         str, "",
         "Streaming telemetry export (observability/export.py): path of a "

@@ -22,7 +22,11 @@ metrics registry): ``serving.request_ms`` (submit -> result),
 ``serving.queue_ms`` (submit -> batch start), ``serving.batch_ms``,
 ``serving.batch_fill`` (rows/bucket), ``serving.queue_depth``
 (histogram, sampled at each dispatch; also a live gauge), counters
-``serving.requests`` / ``serving.batches`` / ``serving.padded_rows``.
+``serving.requests`` / ``serving.batches`` / ``serving.padded_rows``,
+and ``serving.request_goodput`` — the executing fraction of each
+request's wall (the rest is queue wait + batching delay), the
+request-granularity twin of the training goodput ledger; batch-mean
+mirrored as the ``goodput.serving_request_frac`` gauge.
 
 Readiness (ungated): with an SLO configured (``slo_ms`` ctor arg /
 ``PADDLE_TPU_SERVING_SLO_MS``) every request's latency also feeds an
@@ -322,11 +326,24 @@ class InferenceServer:
             except Exception:
                 pass
         if obs.enabled():
-            obs.observe("serving.batch_ms", (t_done - t_start) * 1000.0)
+            exec_ms = (t_done - t_start) * 1000.0
+            obs.observe("serving.batch_ms", exec_ms)
             obs.observe("serving.batch_fill", rows / float(bucket))
+            # per-request goodput: the fraction of the request's wall
+            # that was the batch actually executing — the remainder is
+            # queue wait + batching delay (the serving-side badput the
+            # SLO burn monitor reacts to). Same decomposition as the
+            # training ledger, at request granularity.
+            frac_sum = 0.0
             for r in batch:
-                obs.observe("serving.request_ms",
-                            (t_done - r.t_enq) * 1000.0)
+                total_ms = (t_done - r.t_enq) * 1000.0
+                frac = min(1.0, exec_ms / total_ms) if total_ms > 0 \
+                    else 1.0
+                frac_sum += frac
+                obs.observe("serving.request_ms", total_ms)
+                obs.observe("serving.request_goodput", frac)
+            obs.set_gauge("goodput.serving_request_frac",
+                          frac_sum / len(batch))
             obs.inc("serving.requests", len(batch))
             obs.inc("serving.batches")
             obs.inc("serving.padded_rows", bucket - rows)

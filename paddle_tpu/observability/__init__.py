@@ -28,7 +28,12 @@ these host spans together with the jax device trace.
 """
 
 from paddle_tpu import flags
-from paddle_tpu.observability import export, health, memory  # noqa: F401
+from paddle_tpu.observability import (  # noqa: F401
+    export,
+    goodput,
+    health,
+    memory,
+)
 from paddle_tpu.observability.export import (  # noqa: F401
     FlightRecorder,
     JsonlSink,
@@ -50,7 +55,8 @@ from paddle_tpu.observability.tracing import (  # noqa: F401
 __all__ = [
     "FlightRecorder", "JsonlSink", "MetricsRegistry", "SpanTracer",
     "attach_sink", "counter_value", "detach_sink", "dump_chrome_trace",
-    "enabled", "event", "flush_sink", "inc", "observe", "registry",
+    "enabled", "event", "flush_sink", "goodput", "inc", "observe",
+    "registry",
     "health", "reset", "set_enabled", "set_gauge", "sink", "snapshot",
     "snapshot_text", "span", "spans", "time_block", "tracer",
 ]
@@ -135,9 +141,18 @@ def detach_sink():
     return prev
 
 
-def flush_sink():
+def flush_sink(snap=False):
+    """Flush the active sink; ``snap=True`` also forces a metrics
+    snapshot first — a run's exit seams use it so the FINAL gauge
+    values (goodput ledger, watermarks) land on disk even when the
+    process never detaches the sink."""
     s = tracer.sink
     if s is not None:
+        if snap:
+            try:
+                s.emit_snapshot(force=True)
+            except Exception:
+                pass
         s.flush()
 
 
@@ -237,3 +252,4 @@ def reset():
     registry.reset()
     tracer.reset()
     memory.reset_peaks()
+    goodput.reset()

@@ -1,0 +1,388 @@
+"""Goodput ledger & MFU attribution: charge every wall-clock second.
+
+The production question a fleet is judged by is not step latency but
+*goodput*: what fraction of job wall-clock made forward progress, and
+where did the rest go (arXiv:2011.03641 frames TPU throughput exactly
+as step-time decomposition; arXiv:1909.09756 shows pod-scale efficiency
+work is impossible without per-phase attribution). This module is the
+single ledger both halves of the stack feed:
+
+- **Worker side** (``GoodputTracker``): an interval ledger over
+  ``time.monotonic()``. Seams *mark* category boundaries in temporal
+  order — the engine marks ``compile`` after a cache-miss build and
+  after the first-call XLA compile, the pipeline marks ``input_wait``
+  after a prefetch-queue wait and ``host_sync`` after a deferred-fetch
+  retire, the driver marks ``ckpt_critical`` / ``rollback_replay`` /
+  ``preempt_drain`` / ``restart_downtime`` around its recovery seams,
+  and the executor marks ``compute`` at every step boundary. A charge
+  never overlaps a previous one (the cursor clips it; fully-overlapped
+  charges are rejected and counted), gaps between charges are filled as
+  ``idle``, and charges tagged with a stale incarnation are fenced out.
+  Conservation is therefore exact *by construction*: the category sums
+  equal ``cursor - t0`` to float precision — the ε in tests covers only
+  external wall measurement, not ledger drift.
+
+- **Supervisor side** (``JobLedger``): the same ledger driven by
+  ``distributed/launch.py`` across gang incarnations. Gang-up intervals
+  are goodput (the fleet is working); the cross-incarnation gaps —
+  restart backoff + relaunch, shrink re-plan, preemption drain — are
+  charged to ``restart_downtime`` / ``shrink_rejit`` / ``preempt_drain``
+  so no second is silently lost across process boundaries.
+
+MFU attribution rides on the same ledger: the engine registers each
+executable's ``cost_analysis()`` FLOPs at the cache-miss seam and notes
+them per run; the tracker publishes ``mfu.model_flops_per_step``,
+achieved FLOP/s over *compute* seconds, and a goodput-adjusted MFU that
+divides by total wall — the number that drops when badput seconds pile
+up even though the kernels themselves are fast. Peak FLOP/s comes from
+``PADDLE_TPU_PEAK_FLOPS`` (mandatory on CPU probes, where jax reports
+no peak).
+
+Gated by ``PADDLE_TPU_GOODPUT`` — with the flag down every seam is one
+module-bool check, same discipline as the metrics layer.
+"""
+
+import contextlib
+import os
+import threading
+import time
+
+from paddle_tpu import flags
+
+#: Exhaustive, mutually-exclusive wall-clock categories. Every charged
+#: second lands in exactly one; ``idle`` absorbs the gaps between marks.
+CATEGORIES = (
+    "compute",           # jitted steps making forward progress
+    "compile",           # cache-miss executable build + first-call XLA compile
+    "input_wait",        # blocked on the input pipeline (prefetch queue)
+    "host_sync",         # deferred-fetch retire / device_get barriers
+    "ckpt_critical",     # blocking part of a checkpoint save
+    "rollback_replay",   # re-running steps already paid for once
+    "restart_downtime",  # process death -> relaunch -> resume restore
+    "shrink_rejit",      # elastic shrink re-plan + re-jit on the new mesh
+    "preempt_drain",     # graceful-eviction drain + final checkpoint
+    "idle",              # wall clock no seam claimed
+)
+
+#: The categories that count as forward progress. ``input_wait`` and
+#: ``host_sync`` are pipeline overlap, not waste — the clean-run
+#: acceptance bar (>= 0.99) is over this sum.
+GOODPUT_CATEGORIES = ("compute", "input_wait", "host_sync")
+
+_ENABLED = None
+
+
+def enabled():
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = bool(flags.get_flag("goodput"))
+    return _ENABLED
+
+
+def set_enabled(value=None):
+    """Force the gate, or re-read the flag when ``value`` is None."""
+    global _ENABLED
+    _ENABLED = bool(flags.get_flag("goodput")) if value is None else bool(value)
+
+
+def _current_attempt():
+    try:
+        return int(os.environ.get("PADDLE_TPU_RESTART_COUNT", "0") or 0)
+    except ValueError:
+        return 0
+
+
+class GoodputTracker:
+    """Monotonic, non-overlapping, exhaustive interval ledger.
+
+    ``charge(category, start, end)`` is the primitive: clipped against
+    the cursor, gap-filled with ``idle``, fenced by incarnation.
+    ``mark(category)`` is the sequential helper the seams use: it
+    charges ``[last_mark, now)`` and advances — callers never compute
+    intervals themselves, so overlap is impossible on the hot path.
+    """
+
+    def __init__(self, attempt=None):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.attempt = _current_attempt() if attempt is None else int(attempt)
+        self._reset_locked()
+
+    def _reset_locked(self):
+        self._ms = {c: 0.0 for c in CATEGORIES}
+        self._t0 = None
+        self._cursor = None
+        self._last_mark = None
+        self._overlap_rejected = 0
+        self._fenced = 0
+        self._steps = 0
+        self._flops_total = 0.0
+        self._flops_per_step = 0.0
+
+    def reset(self, attempt=None):
+        """Drop all charges (e.g. after a warmup window) and re-anchor
+        lazily at the next charge."""
+        with self._lock:
+            if attempt is not None:
+                self.attempt = int(attempt)
+            self._reset_locked()
+
+    # -- primitive ---------------------------------------------------------
+    def charge(self, category, start, end, attempt=None):
+        """Charge ``[start, end)`` (``time.monotonic()`` seconds) to
+        ``category``. Returns the ms actually charged (0.0 when fenced,
+        rejected, or fully clipped)."""
+        redirect = getattr(self._local, "redirect", None)
+        if redirect:
+            category = redirect.get(category, category)
+        if category not in self._ms:
+            raise ValueError("unknown goodput category %r" % (category,))
+        with self._lock:
+            if attempt is not None and int(attempt) != self.attempt:
+                self._fenced += 1
+                return 0.0
+            if end <= start:
+                self._overlap_rejected += 1
+                return 0.0
+            if self._t0 is None:
+                self._t0 = self._cursor = start
+            if end <= self._cursor:
+                # fully behind the cursor: someone already owns this wall
+                self._overlap_rejected += 1
+                return 0.0
+            if start < self._cursor:
+                start = self._cursor  # clip the overlapped prefix
+            elif start > self._cursor:
+                self._ms["idle"] += (start - self._cursor) * 1000.0
+            charged = (end - start) * 1000.0
+            self._ms[category] += charged
+            self._cursor = end
+            return charged
+
+    # -- sequential marks (hot path) ---------------------------------------
+    def mark(self, category, now=None):
+        """Charge ``[last_mark, now)`` to ``category`` and advance the
+        mark. The first mark only anchors (nothing to charge yet) —
+        that lazily excludes pre-training setup from the ledger."""
+        now = time.monotonic() if now is None else now
+        last, self._last_mark = self._last_mark, now
+        if last is None:
+            with self._lock:
+                if self._t0 is None:
+                    self._t0 = self._cursor = now
+            return 0.0
+        return self.charge(category, last, now)
+
+    @contextlib.contextmanager
+    def redirected(self, mapping):
+        """Thread-locally remap categories for the duration — the
+        driver wraps replayed steps in ``{"compute": "rollback_replay"}``
+        so re-earned progress is not double-counted as goodput."""
+        prev = getattr(self._local, "redirect", None)
+        merged = dict(prev or {})
+        merged.update(mapping)
+        self._local.redirect = merged
+        try:
+            yield
+        finally:
+            self._local.redirect = prev
+
+    # -- MFU ---------------------------------------------------------------
+    def note_flops(self, flops):
+        """Accumulate one executable run's model FLOPs (from the
+        cache-miss ``cost_analysis()`` capture)."""
+        if flops and flops > 0:
+            with self._lock:
+                self._flops_total += float(flops)
+
+    def note_step(self):
+        with self._lock:
+            self._steps += 1
+            if self._steps:
+                self._flops_per_step = self._flops_total / self._steps
+
+    # -- reporting ---------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            cats = dict(self._ms)
+            wall = 0.0 if self._t0 is None else (self._cursor - self._t0) * 1e3
+            steps = self._steps
+            flops_total = self._flops_total
+            flops_per_step = self._flops_per_step
+            overlap = self._overlap_rejected
+            fenced = self._fenced
+            attempt = self.attempt
+        good = sum(cats[c] for c in GOODPUT_CATEGORIES)
+        frac = (good / wall) if wall > 0 else 1.0
+        compute_s = cats["compute"] / 1e3
+        wall_s = wall / 1e3
+        achieved = (flops_total / compute_s) if compute_s > 0 else 0.0
+        peak = float(flags.get_flag("peak_flops") or 0.0)
+        out = {
+            "wall_ms": wall,
+            "goodput_ms": good,
+            "badput_ms": wall - good,
+            "goodput_frac": frac,
+            "categories": cats,
+            "steps": steps,
+            "attempt": attempt,
+            "overlap_rejected": overlap,
+            "fenced": fenced,
+            "mfu": {
+                "model_flops_per_step": flops_per_step,
+                "total_flops": flops_total,
+                "achieved_flops_per_s": achieved,
+                "peak_flops": peak,
+                # None, not 0.0, when no peak is configured — an MFU of
+                # zero is a real (alarming) measurement, absence is not
+                "mfu": (achieved / peak) if peak > 0 else None,
+                "goodput_mfu": (flops_total / wall_s / peak)
+                if (peak > 0 and wall_s > 0) else None,
+            },
+        }
+        return out
+
+    def top_badput(self):
+        """``(category, ms)`` of the largest non-goodput category —
+        the one-line attribution answer."""
+        snap = self.snapshot()
+        bad = [(c, m) for c, m in snap["categories"].items()
+               if c not in GOODPUT_CATEGORIES]
+        bad.sort(key=lambda cm: -cm[1])
+        return bad[0] if bad else ("idle", 0.0)
+
+    def publish(self, registry=None):
+        """Mirror the ledger into the metrics registry as ``goodput.*``
+        / ``mfu.*`` gauges, so snap events, ``snapshot_text()``, the
+        ``.metrics.prom`` dump, ``perf_report --goodput`` and
+        ``tpu_top`` all see it with zero extra plumbing."""
+        if registry is None:
+            from paddle_tpu import observability as obs
+            registry = obs.registry
+        snap = self.snapshot()
+        registry.set_gauge("goodput.frac", snap["goodput_frac"])
+        registry.set_gauge("goodput.wall_ms", snap["wall_ms"])
+        registry.set_gauge("goodput.badput_ms", snap["badput_ms"])
+        registry.set_gauge("goodput.attempt", float(snap["attempt"]))
+        for c, v in snap["categories"].items():
+            registry.set_gauge("goodput.%s_ms" % c, v)
+        mfu = snap["mfu"]
+        registry.set_gauge("mfu.model_flops_per_step",
+                           mfu["model_flops_per_step"])
+        registry.set_gauge("mfu.achieved_flops_per_s",
+                           mfu["achieved_flops_per_s"])
+        if mfu["peak_flops"] > 0:
+            registry.set_gauge("mfu.peak_flops", mfu["peak_flops"])
+            registry.set_gauge("mfu.mfu", mfu["mfu"])
+            registry.set_gauge("mfu.goodput_mfu", mfu["goodput_mfu"])
+        return snap
+
+
+def record_compile_flops(jitted, args):
+    """AOT-retrace the already-compiled jitted callable to read its
+    ``cost_analysis()`` model FLOPs (the same lowering-cache reuse as
+    ``memory.record_compile_memory`` — a retrace, not a recompile). Any
+    backend/tracing failure returns None: telemetry must never take
+    down a step that already succeeded."""
+    try:
+        import jax
+
+        specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+        cost = jitted.lower(*specs).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return None
+
+
+class JobLedger(GoodputTracker):
+    """Supervisor-level ledger across gang incarnations.
+
+    ``gang(start, end)`` charges fleet-up time as goodput (``compute``);
+    ``gap(category, start, end)`` charges the dead air between
+    incarnations to the exit-path category. ``next_incarnation()``
+    advances the fence so straggler charges from a torn-down gang are
+    rejected instead of corrupting the new incarnation's ledger.
+    """
+
+    def next_incarnation(self):
+        with self._lock:
+            self.attempt += 1
+        return self.attempt
+
+    def gang(self, start, end, attempt=None):
+        return self.charge("compute", start, end, attempt=attempt)
+
+    def gap(self, category, start, end, attempt=None):
+        return self.charge(category, start, end, attempt=attempt)
+
+
+#: Process-wide tracker the seams feed. Reset via ``reset()`` below
+#: (wired into ``observability.reset()`` for test isolation).
+tracker = GoodputTracker()
+
+
+def mark(category, now=None):
+    """Module-level hot-path mark: one bool check when the flag is
+    down (the same discipline as ``observability.enabled()``)."""
+    if not enabled():
+        return 0.0
+    return tracker.mark(category, now)
+
+
+def note_flops(flops):
+    if enabled():
+        tracker.note_flops(flops)
+
+
+def step_boundary():
+    """End-of-step seam: charge the remainder of the step as
+    ``compute``, count the step, and refresh the published gauges."""
+    if not enabled():
+        return
+    tracker.mark("compute")
+    tracker.note_step()
+    try:
+        tracker.publish()
+    except Exception:
+        pass  # telemetry must never take down a step that succeeded
+
+
+def redirected(mapping):
+    """Thread-local category remap for the with-block (no-op when the
+    flag is down)."""
+    if not enabled():
+        return contextlib.nullcontext()
+    return tracker.redirected(mapping)
+
+
+def replay_redirect():
+    """Context manager redirecting ``compute`` to ``rollback_replay``
+    (no-op when the flag is down)."""
+    return redirected({"compute": "rollback_replay"})
+
+
+def publish():
+    """Refresh the ``goodput.*`` / ``mfu.*`` gauges (no-op when the
+    flag is down; failures never propagate)."""
+    if not enabled():
+        return None
+    try:
+        return tracker.publish()
+    except Exception:
+        return None
+
+
+def snapshot():
+    return tracker.snapshot()
+
+
+def reset():
+    global _ENABLED
+    tracker.reset(attempt=_current_attempt())
+    _ENABLED = None
+
+
+flags.on_change("goodput", lambda _v: set_enabled(None))

@@ -183,13 +183,11 @@ class SpanTracer:
 
     def chrome_trace(self, xplane_dir=None):
         """Full chrome-trace dict. With ``xplane_dir`` the device planes
-        converted by tools/timeline.py are merged in as further
+        (``xplane_to_chrome_trace`` below) are merged in as further
         processes — one file, host spans above the device lanes, shared
         wall clock."""
         events = self.chrome_trace_events()
         if xplane_dir is not None:
-            from tools.timeline import xplane_to_chrome_trace
-
             device = xplane_to_chrome_trace(xplane_dir)["traceEvents"]
             for ev in device:
                 ev = dict(ev)
@@ -221,6 +219,43 @@ class SpanTracer:
         for row in agg.values():
             row["ave_ms"] = row["total_ms"] / row["calls"]
         return agg
+
+
+def xplane_to_chrome_trace(trace_dir, line_filter=None):
+    """-> chrome-trace dict {"traceEvents": [...], "displayTimeUnit":
+    "ms"} from every distinct .xplane.pb under ``trace_dir``
+    (byte-identical duplicate dumps are skipped by the shared plane
+    iterator). Every plane becomes a chrome "process", every line a
+    "thread", events map to complete ("X") slices with microsecond
+    timestamps sharing the epoch wall clock the host spans use.
+    ``line_filter`` (substring, e.g. "XLA Ops") keeps matching lines
+    only. Folded in from tools/timeline.py so the package owns ONE
+    trace-export entry point (``dump_chrome_trace(path, xplane_dir)``);
+    the tools CLI is now a thin shim over this."""
+    from tools.xplane_top_ops import iter_planes
+
+    events = []
+    for pid, plane in enumerate(iter_planes(trace_dir), start=1):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": plane.name}})
+        meta = {m.id: m.name for m in plane.event_metadata.values()}
+        for tid, line in enumerate(plane.lines):
+            if line_filter and line_filter not in line.name:
+                continue
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": line.name}})
+            t0_ns = line.timestamp_ns
+            for e in line.events:
+                events.append({
+                    "name": meta.get(e.metadata_id, "?"),
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": (t0_ns + e.offset_ps / 1e3) / 1e3,  # us
+                    "dur": e.duration_ps / 1e6,               # us
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 class _Span:

@@ -97,8 +97,23 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         with open(profile_path, "w") as f:
             f.write(table + "\n")
         observability.dump_chrome_trace(profile_path + ".trace.json")
+        # refresh the goodput.*/mfu.* gauges first so the exposition
+        # dump carries the ledger, then append the human-readable
+        # summary block (comment lines — any Prometheus parser skips
+        # them) answering "where did the wall clock go" inline
+        observability.goodput.publish()
         with open(profile_path + ".metrics.prom", "w") as f:
             f.write(observability.registry.snapshot_text())
+            if observability.goodput.enabled():
+                snap = observability.goodput.snapshot()
+                f.write("# goodput ledger: %.2f%% of %.1f ms wall "
+                        "(attempt %d)\n"
+                        % (100.0 * snap["goodput_frac"], snap["wall_ms"],
+                           snap["attempt"]))
+                for cat, ms in sorted(snap["categories"].items(),
+                                      key=lambda cm: -cm[1]):
+                    if ms > 0:
+                        f.write("#   %-16s %12.3f ms\n" % (cat, ms))
     observability.flush_sink()
     observability.set_enabled(None)  # back to the PADDLE_TPU_METRICS gate
     if _trace_dir:

@@ -121,6 +121,11 @@ class ResilientDriver:
                                  main_program=self.program,
                                  scope=self.scope, blocking=blocking)
         obs.inc("recovery.ckpt_saved")
+        # the critical path the step loop actually waited on: the host
+        # snapshot (async) or the full write (blocking). The drain
+        # before a save already marked host_sync, so this charge is the
+        # save alone.
+        obs.goodput.mark("ckpt_critical")
 
     def resume_step(self):
         """The step a fresh ``train`` would resume from (latest complete
@@ -182,6 +187,9 @@ class ResilientDriver:
         obs.inc("recovery.rollback")
         obs.event("recovery.rollback", failed_step=failed_step,
                   restored_step=step, reason=str(exc)[:200])
+        # window discard + writer join + restore: all wall the fault
+        # cost, charged with the steps about to be replayed
+        obs.goodput.mark("rollback_replay")
         return step
 
     # -- lifecycle ---------------------------------------------------------
@@ -241,20 +249,30 @@ class ResilientDriver:
         poisoned), take a BLOCKING checkpoint, flush telemetry, exit
         with the code the supervisor restarts without budget."""
         obs.inc("recovery.preempted")
-        try:
-            self._drain()
-            self._save(step, blocking=True)
-        except Exception:
-            # a fault surfaced while draining: do not publish that state
-            # — the latest complete checkpoint is already durable
-            engine = getattr(self.exe, "engine", None)
-            if engine is not None and hasattr(engine, "discard_window"):
-                engine.discard_window()
+        # the whole eviction protocol — drain (host_sync at retire) and
+        # blocking save (ckpt_critical) — is preemption cost: the
+        # eviction chose the timing, so every inner charge lands in
+        # preempt_drain
+        with obs.goodput.redirected({"host_sync": "preempt_drain",
+                                     "ckpt_critical": "preempt_drain",
+                                     "compute": "preempt_drain"}):
             try:
-                self.manager.wait()
+                self._drain()
+                self._save(step, blocking=True)
             except Exception:
-                pass
+                # a fault surfaced while draining: do not publish that
+                # state — the latest complete checkpoint is durable
+                engine = getattr(self.exe, "engine", None)
+                if engine is not None and hasattr(engine,
+                                                  "discard_window"):
+                    engine.discard_window()
+                try:
+                    self.manager.wait()
+                except Exception:
+                    pass
         obs.event("recovery.preempted", step=step)
+        obs.goodput.mark("preempt_drain")
+        obs.goodput.publish()
         try:
             obs.flush_sink()
         except Exception:
@@ -350,6 +368,13 @@ class ResilientDriver:
             return self._train_impl(batch_fn, n_steps, start_step, on_step)
         finally:
             self._restore_sigterm()
+            if obs.goodput.enabled():
+                # final ledger state must reach the sink: a worker that
+                # never detaches (killed next incarnation, or just
+                # exits) would otherwise leave only mid-compile snaps
+                # behind and perf_report --goodput would see no gauges
+                obs.goodput.publish()
+                obs.flush_sink(snap=True)
 
     def _train_impl(self, batch_fn, n_steps, start_step, on_step):
         if start_step is None:
@@ -357,11 +382,16 @@ class ResilientDriver:
             if start_step is not None:
                 from paddle_tpu import io
 
+                # anchor the ledger before the restore so the resume
+                # wall (the worker-side tail of a restart) is charged,
+                # not silently excluded by the lazy first-mark anchor
+                obs.goodput.mark("idle")
                 io.load_checkpoint(self.manager,
                                    main_program=self.program,
                                    scope=self.scope, step=start_step)
                 obs.inc("recovery.resume")
                 obs.event("recovery.resume", step=start_step)
+                obs.goodput.mark("restart_downtime")
             else:
                 start_step = 0
         if start_step == 0:
@@ -371,6 +401,10 @@ class ResilientDriver:
         results = {}
         skip = set()
         step = start_step
+        # highest step ever reached this process: a step below it is a
+        # REPLAY after a rollback — its wall is re-earned, not new
+        # progress, so the ledger books it as rollback_replay
+        high_water = start_step
         while True:
             if step >= n_steps:
                 # drain the dispatch window before the final save: a
@@ -418,9 +452,12 @@ class ResilientDriver:
                     for k in sorted(self._engine_steps)[:-64]:
                         del self._engine_steps[k]
             try:
-                out = self.exe.run(self.program, feed=feed,
-                                   fetch_list=self.fetch_list,
-                                   scope=self.scope)
+                with obs.goodput.redirected(
+                        {"compute": "rollback_replay"}
+                        if step < high_water else {}):
+                    out = self.exe.run(self.program, feed=feed,
+                                       fetch_list=self.fetch_list,
+                                       scope=self.scope)
             except SDCSuspect as e:
                 step = self._sdc_recover(e, results, on_step)
                 continue
@@ -439,6 +476,7 @@ class ResilientDriver:
             if on_step is not None:
                 on_step(step, out)
             step += 1
+            high_water = max(high_water, step)
             if self.ckpt_interval and step % self.ckpt_interval == 0 \
                     and step < n_steps:
                 # drain first: every step the checkpoint will cover must

@@ -633,3 +633,164 @@ def test_tpu_top_tail_survives_torn_lines(tmp_path):
         f.flush()
         evs = tail.poll()
         assert len(evs) == 1 and evs[0]["name"] == "x"
+
+
+# -- goodput ledger & MFU attribution ------------------------------------
+
+@pytest.fixture
+def goodput_on():
+    flags.set_flags({"goodput": True})
+    try:
+        yield
+    finally:
+        flags.reset_flag("goodput")
+
+
+def test_goodput_charge_clip_gapfill_and_conservation():
+    from paddle_tpu.observability import goodput
+
+    t = goodput.GoodputTracker(attempt=0)
+    assert t.charge("compute", 10.0, 10.5) == pytest.approx(500.0)
+    # the [10.5, 10.7) hole no seam claimed fills as idle
+    assert t.charge("host_sync", 10.7, 10.8) == pytest.approx(100.0)
+    # overlapped prefix clips against the cursor instead of double-charging
+    assert t.charge("ckpt_critical", 10.75, 10.9) == pytest.approx(100.0)
+    snap = t.snapshot()
+    assert snap["wall_ms"] == pytest.approx(900.0)
+    # conservation is exact by construction, not within some epsilon
+    assert sum(snap["categories"].values()) == pytest.approx(
+        snap["wall_ms"], abs=1e-9)
+    assert snap["categories"]["idle"] == pytest.approx(200.0)
+    assert snap["goodput_frac"] == pytest.approx(600.0 / 900.0)
+    assert t.top_badput()[0] == "idle"
+
+
+def test_goodput_overlap_rejection_and_incarnation_fence():
+    from paddle_tpu.observability import goodput
+
+    t = goodput.GoodputTracker(attempt=0)
+    assert t.charge("compute", 1.0, 2.0) == pytest.approx(1000.0)
+    assert t.charge("compile", 0.2, 0.9) == 0.0   # fully behind the cursor
+    assert t.charge("compile", 1.5, 1.8) == 0.0   # ditto, inside the charge
+    assert t.charge("compute", 3.0, 2.5) == 0.0   # empty/backwards interval
+    assert t.charge("compute", 2.0, 3.0, attempt=5) == 0.0  # stale fence
+    with pytest.raises(ValueError):
+        t.charge("naptime", 2.0, 3.0)
+    snap = t.snapshot()
+    assert snap["overlap_rejected"] == 3
+    assert snap["fenced"] == 1
+    assert snap["wall_ms"] == pytest.approx(1000.0)  # rejects charged nothing
+
+
+def test_goodput_marks_anchor_and_redirect():
+    from paddle_tpu.observability import goodput
+
+    t = goodput.GoodputTracker(attempt=0)
+    assert t.mark("compute", now=5.0) == 0.0  # first mark only anchors
+    assert t.mark("compute", now=5.25) == pytest.approx(250.0)
+    with t.redirected({"compute": "rollback_replay"}):
+        # a replayed step books as badput even though the seam says compute
+        assert t.mark("compute", now=5.5) == pytest.approx(250.0)
+    assert t.mark("compute", now=5.75) == pytest.approx(250.0)
+    cats = t.snapshot()["categories"]
+    assert cats["compute"] == pytest.approx(500.0)
+    assert cats["rollback_replay"] == pytest.approx(250.0)
+
+
+def test_job_ledger_gangs_gaps_and_fencing():
+    from paddle_tpu.observability import goodput
+
+    led = goodput.JobLedger(attempt=0)
+    led.gang(100.0, 160.0, attempt=0)
+    assert led.next_incarnation() == 1
+    # a straggler charge from the torn-down gang is fenced, not booked
+    assert led.gang(160.0, 170.0, attempt=0) == 0.0
+    led.gap("restart_downtime", 160.0, 164.0, attempt=1)
+    led.gang(164.0, 224.0, attempt=1)
+    snap = led.snapshot()
+    assert snap["attempt"] == 1 and snap["fenced"] == 1
+    assert snap["categories"]["compute"] == pytest.approx(120000.0)
+    assert snap["categories"]["restart_downtime"] == pytest.approx(4000.0)
+    assert snap["goodput_frac"] == pytest.approx(120.0 / 124.0)
+
+
+def test_goodput_disabled_is_one_bool_check():
+    from paddle_tpu.observability import goodput
+
+    assert not goodput.enabled()
+    assert goodput.mark("compute") == 0.0
+    goodput.step_boundary()
+    snap = goodput.snapshot()
+    assert snap["wall_ms"] == 0.0 and snap["steps"] == 0
+
+
+def _goodput_mlp():
+    from paddle_tpu.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="qx", shape=[32], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        loss = fluid.layers.mean(fluid.layers.fc(input=h, size=4))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    feed = {"qx": np.random.RandomState(0).randn(8, 32).astype(np.float32)}
+    return main, startup, loss, feed
+
+
+def test_clean_run_goodput_conservation_and_mfu(goodput_on):
+    """The acceptance bar: a clean engine run books >= 99% of its
+    steady-state wall as goodput, the categories conserve within 1%,
+    and the FLOPs captured at the cache-miss seam yield an MFU once
+    PADDLE_TPU_PEAK_FLOPS supplies the denominator."""
+    from paddle_tpu.observability import goodput
+
+    main, startup, loss, feed = _goodput_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):            # warmup: the jit compile lands here
+            exe.run(main, feed=feed, fetch_list=[loss])
+        goodput.reset()               # measure steady state only
+        flags.set_flags({"peak_flops": 1e12})
+        try:
+            for _ in range(20):
+                exe.run(main, feed=feed, fetch_list=[loss])
+            snap = goodput.snapshot()
+            gauges = obs.registry.snapshot()["gauges"]
+        finally:
+            flags.reset_flag("peak_flops")
+    assert snap["steps"] == 20
+    assert snap["goodput_frac"] >= 0.99
+    cats = snap["categories"]
+    assert abs(sum(cats.values()) - snap["wall_ms"]) \
+        <= 0.01 * max(snap["wall_ms"], 1e-9)
+    mfu = snap["mfu"]
+    assert mfu["model_flops_per_step"] > 0
+    assert mfu["achieved_flops_per_s"] > 0
+    assert mfu["mfu"] > 0
+    assert 0 < mfu["goodput_mfu"] <= mfu["mfu"] + 1e-12
+    # step_boundary published the gauges with NO metrics flag set — the
+    # ledger must be visible to snap events / tpu_top on its own
+    assert gauges["goodput.frac"] >= 0.99
+    assert "goodput.compute_ms" in gauges and "mfu.mfu" in gauges
+
+
+def test_stop_profiler_appends_goodput_block(tmp_path, monkeypatch,
+                                             goodput_on):
+    """stop_profiler's .metrics.prom dump carries the goodput summary
+    block when the ledger is live."""
+    from paddle_tpu import profiler
+    from paddle_tpu.observability import goodput
+
+    goodput.tracker.mark("compute", now=1.0)
+    goodput.tracker.mark("compute", now=1.2)
+    goodput.tracker.mark("restart_downtime", now=1.3)
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path / "trace"))
+    ppath = str(tmp_path / "profile.txt")
+    with profiler.profiler(profile_path=ppath):
+        np.ones(4).sum()
+    text = open(ppath + ".metrics.prom").read()
+    assert "# goodput ledger:" in text
+    assert "restart_downtime" in text
+    assert "paddle_tpu_goodput_frac" in text  # gauges rode along too

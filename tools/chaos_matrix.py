@@ -26,7 +26,12 @@ Usage::
 
 Every gate asserts bit-exact (or, under --mesh paths, allclose) loss
 parity against a fault-free reference on top of its own recovery-path
-assertions — see chaos_run.py for what each flag checks.
+assertions — see chaos_run.py for what each flag checks. Every gate
+also asserts the supervisor's goodput job ledger conserves (categories
+sum to wall within 1%) and charged the injected fault's wall cost to
+the right badput category (kill -> restart_downtime, preempt ->
+preempt_drain, shrink -> shrink_rejit); the table's ``badput=`` detail
+shows the attribution.
 """
 
 import argparse
@@ -128,6 +133,10 @@ def main():
         if r["ok"]:
             detail = ",".join(v.get("sentinel_events")
                               or v.get("recovery_events") or [])[:60]
+            if v.get("goodput_attr"):
+                # where the injected fault's wall cost landed (asserted
+                # per-gate in chaos_run.py — this column is the summary)
+                detail += "  badput=%s" % v["goodput_attr"]
         else:
             detail = "; ".join(v.get("problems", [])) or r["note"] \
                 or "rc %s, no verdict" % r["rc"]

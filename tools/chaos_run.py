@@ -310,6 +310,10 @@ def run_supervisor(args):
         "PADDLE_TPU_FAULT_SPEC": spec,
         "PADDLE_TPU_METRICS": "1",
         "PADDLE_TPU_METRICS_SINK": sink,
+        # workers keep their own interval ledgers (goodput.* gauges in
+        # the snap stream); the supervisor's JobLedger covers the
+        # cross-incarnation gaps and lands in stats["goodput"]
+        "PADDLE_TPU_GOODPUT": "1",
     }
     if args.sdc:
         # arm the sentinel in every worker: in-graph digests, replay
@@ -448,6 +452,44 @@ def run_supervisor(args):
             problems.append(
                 "preemption burned restart budget (recovery.restart "
                 "= %d, expected 0)" % verdict["restarts"])
+    # goodput attribution gate: the supervisor's job ledger must (a)
+    # conserve — categories sum to wall clock within 1% — and (b) have
+    # charged the injected fault's cost to the RIGHT badput category,
+    # not diffused it into idle
+    job = stats.get("goodput") or {}
+    cats = job.get("categories") or {}
+    verdict["goodput"] = {
+        "wall_ms": round(job.get("wall_ms", 0.0), 1),
+        "goodput_frac": round(job.get("goodput_frac", 0.0), 4),
+        "categories": {c: round(m, 1) for c, m in cats.items() if m > 0},
+    }
+    badput = {c: m for c, m in cats.items()
+              if c not in ("compute", "input_wait", "host_sync")
+              and m > 0}
+    verdict["goodput_attr"] = (
+        "%s:%.0fms" % max(badput.items(), key=lambda cm: cm[1])
+        if badput else "clean")
+    wall = job.get("wall_ms", 0.0)
+    if not cats:
+        problems.append("the supervisor recorded no job goodput ledger")
+    elif wall > 0:
+        err = abs(sum(cats.values()) - wall) / wall
+        if err > 0.01:
+            problems.append(
+                "job ledger does not conserve: categories sum to "
+                "%.1fms over %.1fms wall (err %.2f%%)"
+                % (sum(cats.values()), wall, 100.0 * err))
+    if verdict["restarts"] > 0 and not cats.get("restart_downtime"):
+        problems.append("gang restarted %d time(s) but the job ledger "
+                        "charged no restart_downtime"
+                        % verdict["restarts"])
+    if args.preempt and not cats.get("preempt_drain"):
+        problems.append("preemption gate but the job ledger charged "
+                        "no preempt_drain")
+    if (args.shrink or args.sdc) and stats.get("shrinks", 0) > 0 \
+            and not cats.get("shrink_rejit"):
+        problems.append("the gang shrank but the job ledger charged "
+                        "no shrink_rejit")
     if args.check_parity and not problems:
         import numpy as np
 

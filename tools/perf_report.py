@@ -121,7 +121,7 @@ def load_worker_dumps(dump_dir):
 
     def w(host):
         return workers.setdefault(
-            host, {"steps": {}, "hbm": {},
+            host, {"steps": {}, "hbm": {}, "goodput": {}, "job": None,
                    "hb": {"count": 0, "last_ts": None, "last_step": None,
                           "step_ts": None},
                    "files": set(), "events": 0, "last_ts": None})
@@ -153,12 +153,21 @@ def load_worker_dumps(dump_dir):
                     if step is not None and step != hb["last_step"]:
                         hb["last_step"] = step
                         hb["step_ts"] = ts
+            elif kind == "span" and ev.get("name") == "goodput.job":
+                # the supervisor's job-ledger event (one per job exit);
+                # later wins, matching file order
+                rec["job"] = ev.get("args") or {}
             elif kind == "snap":
                 gauges = (ev.get("metrics") or {}).get("gauges") or {}
                 for g in HBM_GAUGES:
                     v = gauges.get(g)
                     if v is not None:
                         rec["hbm"][g] = max(rec["hbm"].get(g, 0), int(v))
+                for g, v in gauges.items():
+                    # goodput/mfu gauges are running totals, not
+                    # watermarks: keep the NEWEST value per host
+                    if g.startswith("goodput.") or g.startswith("mfu."):
+                        rec["goodput"][g] = v
     for rec in workers.values():
         rec["files"] = sorted(rec["files"])
     return workers
@@ -270,6 +279,85 @@ def render_merge(workers):
     return "\n".join(lines)
 
 
+def render_goodput(workers):
+    """The fleet badput-attribution report: per-rank goodput %, MFU,
+    and slowest badput category from each rank's ``goodput.*``/``mfu.*``
+    gauges, the fleet-weighted goodput %, and the supervisor's
+    cross-incarnation job ledger (the ``goodput.job`` event) — where
+    restart backoff, shrink re-plans, and preemption drains live."""
+    from paddle_tpu.observability.goodput import (CATEGORIES,
+                                                  GOODPUT_CATEGORIES)
+
+    hosts = sorted(workers)
+    lines = ["== fleet goodput / badput attribution =="]
+    rows = []
+    for h in hosts:
+        g = workers[h]["goodput"]
+        if not g:
+            continue
+        cats = {c: float(g.get("goodput.%s_ms" % c, 0.0))
+                for c in CATEGORIES}
+        bad = sorted(((c, m) for c, m in cats.items()
+                      if c not in GOODPUT_CATEGORIES and m > 0),
+                     key=lambda cm: -cm[1])
+        rows.append({
+            "host": h,
+            "wall": float(g.get("goodput.wall_ms", 0.0)),
+            "frac": g.get("goodput.frac"),
+            "mfu": g.get("mfu.mfu"),
+            "flops_s": g.get("mfu.achieved_flops_per_s"),
+            "top": ("%s %.0fms" % bad[0]) if bad else "-",
+            "good": sum(cats[c] for c in GOODPUT_CATEGORIES),
+        })
+    if rows:
+        hdr = ("host", "wall_s", "goodput%", "mfu%", "flops/s",
+               "top badput")
+        lines.append("  ".join("%10s" % c for c in hdr))
+        for r in rows:
+            lines.append("  ".join([
+                "%10s" % ("h%s" % r["host"]),
+                "%10.2f" % (r["wall"] / 1e3),
+                "%10s" % ("%.2f" % (100.0 * r["frac"])
+                          if r["frac"] is not None else "-"),
+                "%10s" % ("%.1f" % (100.0 * r["mfu"])
+                          if r["mfu"] else "-"),
+                "%10s" % ("%.3g" % r["flops_s"]
+                          if r["flops_s"] else "-"),
+                "  " + r["top"]]))
+        fleet_wall = sum(r["wall"] for r in rows)
+        fleet_good = sum(r["good"] for r in rows)
+        if fleet_wall > 0:
+            lines.append("fleet goodput: %.2f%% over %.1f s of rank wall"
+                         % (100.0 * fleet_good / fleet_wall,
+                            fleet_wall / 1e3))
+    else:
+        lines.append("(no per-rank goodput gauges — was "
+                     "PADDLE_TPU_GOODPUT=1 exported to the workers?)")
+    for h in hosts:
+        job = workers[h]["job"]
+        if not job:
+            continue
+        cats = job.get("categories") or {}
+        bad = sorted(((c, float(m)) for c, m in cats.items()
+                      if c not in GOODPUT_CATEGORIES and float(m) > 0),
+                     key=lambda cm: -cm[1])
+        lines.append("")
+        lines.append("== supervisor job ledger (host %s) ==" % h)
+        lines.append("wall: %.1f s  goodput: %.2f%%  incarnations: %s"
+                     % (float(job.get("wall_ms", 0.0)) / 1e3,
+                        100.0 * float(job.get("goodput_frac", 0.0)),
+                        1 + int(job.get("attempt", 0))))
+        for c, m in bad:
+            lines.append("  %-18s %10.1f ms" % (c, m))
+        if not bad:
+            lines.append("  (no cross-incarnation badput)")
+    return "\n".join(lines)
+
+
+def goodput_report(dump_dir):
+    return render_goodput(load_worker_dumps(dump_dir))
+
+
 def merge_report(dump_dir):
     return render_merge(load_worker_dumps(dump_dir))
 
@@ -310,12 +398,21 @@ def main(argv=None):
                    "dumps (PADDLE_TPU_METRICS_SINK files) into one "
                    "cross-host report: per-step latency skew, "
                    "slowest-worker attribution, aggregate HBM watermarks")
+    p.add_argument("--goodput", metavar="DIR", default=None,
+                   help="merge per-worker JSONL dumps into the fleet "
+                   "goodput/badput-attribution table (per-rank goodput "
+                   "%%, MFU, slowest badput category, fleet goodput %%, "
+                   "and the supervisor's cross-incarnation job ledger)")
     args = p.parse_args(argv)
+    if args.goodput:
+        print(goodput_report(args.goodput))
+        return 0
     if args.merge:
         print(merge_report(args.merge))
         return 0
     if not args.host_trace:
-        p.error("either HOST_TRACE or --merge DIR is required")
+        p.error("either HOST_TRACE, --merge DIR, or --goodput DIR is "
+                "required")
     print(report(args.host_trace, args.xplane_dir, args.top))
     return 0
 

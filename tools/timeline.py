@@ -1,16 +1,16 @@
 #!/usr/bin/env python
-"""Chrome-trace timeline export (capability parity with the REFERENCE
-repo's tools/timeline.py:36, which converts its profiler protos into a
-chrome://tracing JSON; here the source is the jax profiler's xplane
-dump, so the same workflow holds: profile with paddle_tpu.profiler,
-convert, open in chrome://tracing or https://ui.perfetto.dev).
+"""Chrome-trace timeline CLI — thin shim over the package converter.
+
+The xplane→chrome-trace conversion now lives at
+``paddle_tpu.observability.tracing.xplane_to_chrome_trace`` so the
+package owns ONE trace-export entry point
+(``observability.dump_chrome_trace(path, xplane_dir=...)`` merges host
+spans + device planes into a single perfetto view). This CLI is kept
+for the reference workflow (reference repo's tools/timeline.py:36 —
+convert a profiler dump, open in chrome://tracing):
 
 Usage: PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
            python tools/timeline.py <trace_dir> <out.json> [line_filter]
-
-Every xplane plane becomes a chrome "process" and every line a "thread";
-events map to complete ("ph": "X") slices with microsecond timestamps.
-``line_filter`` (substring, e.g. "XLA Ops") keeps only matching lines.
 """
 import json
 import os
@@ -19,35 +19,9 @@ import sys
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), os.pardir)))
 
-
-def xplane_to_chrome_trace(trace_dir, line_filter=None):
-    """-> chrome-trace dict {"traceEvents": [...], "displayTimeUnit": "ms"}
-    from every distinct .xplane.pb under ``trace_dir`` (byte-identical
-    duplicate dumps are skipped by the shared plane iterator)."""
-    from tools.xplane_top_ops import iter_planes
-
-    events = []
-    for pid, plane in enumerate(iter_planes(trace_dir), start=1):
-        events.append({"name": "process_name", "ph": "M", "pid": pid,
-                       "args": {"name": plane.name}})
-        meta = {m.id: m.name for m in plane.event_metadata.values()}
-        for tid, line in enumerate(plane.lines):
-            if line_filter and line_filter not in line.name:
-                continue
-            events.append({"name": "thread_name", "ph": "M",
-                           "pid": pid, "tid": tid,
-                           "args": {"name": line.name}})
-            t0_ns = line.timestamp_ns
-            for e in line.events:
-                events.append({
-                    "name": meta.get(e.metadata_id, "?"),
-                    "ph": "X",
-                    "pid": pid,
-                    "tid": tid,
-                    "ts": (t0_ns + e.offset_ps / 1e3) / 1e3,  # us
-                    "dur": e.duration_ps / 1e6,               # us
-                })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+from paddle_tpu.observability.tracing import (  # noqa: E402,F401
+    xplane_to_chrome_trace,
+)
 
 
 def main():

@@ -158,6 +158,28 @@ def render(state, path, metrics_lines=12, now_us=None):
     else:
         lines.append("hbm:   (no snapshot with hbm gauges yet)")
 
+    frac = gauges.get("goodput.frac")
+    if frac is not None:
+        # live goodput bar: [#### goodput | badput] + the category the
+        # badput is mostly made of (the one-line attribution answer)
+        width = 40
+        filled = max(0, min(width, int(round(frac * width))))
+        bar = "#" * filled + "." * (width - filled)
+        bad = sorted(
+            ((k[len("goodput."):-len("_ms")], v)
+             for k, v in gauges.items()
+             if k.startswith("goodput.") and k.endswith("_ms")
+             and k[len("goodput."):-len("_ms")] not in
+             ("wall", "badput", "compute", "input_wait", "host_sync")
+             and v > 0),
+            key=lambda kv: -kv[1])
+        detail = "   top badput: %s %.0fms" % bad[0] if bad else ""
+        mfu = gauges.get("mfu.mfu")
+        if mfu:
+            detail += "   mfu %.1f%%" % (100.0 * mfu)
+        lines.append("goodput: %5.1f%% [%s]%s"
+                     % (100.0 * frac, bar, detail))
+
     if state.last_nan_inf is not None:
         args = state.last_nan_inf.get("args") or {}
         age_s = max(0.0, (now_us - state.last_nan_inf.get("ts", now_us))
