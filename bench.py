@@ -10,11 +10,12 @@ Primary metric: ResNet-50 train images/sec on whatever device JAX selects
 samples/sec, Transformer-NMT samples/sec, DeepFM examples/sec, the flash
 microbench, and a diagnostic MNIST number) ride along as additional keys —
 all five BASELINE.md configs appear. Select with
-PADDLE_TPU_BENCH=resnet50|bert|transformer|deepfm|flash|mnist|memory|multichip|serving|pipeline|all
+PADDLE_TPU_BENCH=resnet50|bert|transformer|deepfm|flash|mnist|memory|multichip|serving|pipeline|layout|all
 (default: everything except multichip — the multi-device GSPMD scaling
 sweep, see bench_multichip — serving — the INT8 freeze/quantize/
-continuous-batching pipeline, see bench_serving — and pipeline — the
-async-dispatch / prefetch / async-checkpoint block, see bench_pipeline).
+continuous-batching pipeline, see bench_serving — pipeline — the
+async-dispatch / prefetch / async-checkpoint block, see bench_pipeline —
+and layout — the NCHW-vs-NHWC layout-pass A/B, see bench_layout).
 """
 
 import json
@@ -868,6 +869,69 @@ def bench_serving():
     return out
 
 
+def bench_layout(batch=None, steps=30, warmup=5):
+    """PADDLE_TPU_BENCH=layout block: ResNet-50 train throughput with the
+    whole-program NHWC layout pass (analysis/layout.py, opt level 4) vs
+    the same build in framework-native NCHW — both at the same opt level
+    so the ONLY delta is the layout assignment. Also publishes the pass's
+    own minimality evidence: ``layout_transpose_count`` (inserted seam
+    transposes — 3 on this model: feed in, flatten-out, flatten-grad
+    back) and ``layout_nhwc_ops`` from a dry-run plan of the same
+    program, so a future change that starts spraying transposes fails
+    tools/bench_diff.py even if throughput noise masks it."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags, models
+    from paddle_tpu.analysis import plan_layout
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch = batch or (512 if on_tpu else 4)
+    if not on_tpu:
+        steps, warmup = min(steps, 10), min(warmup, 2)
+
+    def _run(layout_mode):
+        main, startup, h = models.resnet.get_model(
+            dataset="imagenet", depth=50, class_num=1000, lr=0.1)
+        if os.environ.get("PADDLE_TPU_AMP", "1") != "0":
+            fluid.contrib.mixed_precision.enable_bf16(main)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        x = jax.device_put(rng.randn(batch, 3, 224, 224).astype(np.float32))
+        y = jax.device_put(
+            rng.randint(0, 1000, (batch, 1)).astype(np.int64))
+        old = {"opt_level": flags.get_flag("opt_level"),
+               "layout": flags.get_flag("layout")}
+        # both sides run the FULL level-4 pipeline; only the layout
+        # flag differs, so the ratio isolates the NHWC rewrite
+        flags.set_flags({"opt_level": 4, "layout": layout_mode})
+        try:
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                step = lambda: exe.run(main, feed={"img": x, "label": y},
+                                       fetch_list=[h["loss"]],
+                                       return_numpy=False)[0]
+                ips, loss = _throughput(step, batch, steps, warmup)
+        finally:
+            flags.set_flags(old)
+        assert np.isfinite(loss)
+        return ips, main, h
+
+    ips_nchw, _, _ = _run("off")
+    ips_nhwc, main, h = _run("nhwc")
+    plan = plan_layout(main.desc, feed_names=["img", "label"],
+                       fetch_names=[h["loss"].name])
+    return {
+        "resnet50_nchw_images_per_sec": round(ips_nchw, 2),
+        "resnet50_nhwc_images_per_sec": round(ips_nhwc, 2),
+        "layout_nhwc_speedup": round(ips_nhwc / ips_nchw, 3)
+        if ips_nchw else 0.0,
+        "layout_transpose_count": plan.transpose_count,
+        "layout_nhwc_ops": plan.n_nhwc_ops,
+        "layout_weights_baked": len(plan.weights),
+    }
+
+
 def bench_pipeline(steps=60, warmup=8, depth=8, reps=5):
     """PADDLE_TPU_BENCH=pipeline block: the async-dispatch window, the
     double-buffered input prefetch, and the off-critical-path checkpoint
@@ -1503,6 +1567,22 @@ def main():
                     "resnet50_int8_images_per_sec"]
         except Exception as e:  # noqa: BLE001
             errors["serving"] = str(e)[:200]
+    layout_metrics = {}
+    if which in ("all", "layout"):
+        # not in "default": two full ResNet-50 timed windows (NCHW +
+        # NHWC) double the headline bench's wall clock;
+        # PADDLE_TPU_BENCH=layout is the layout-pass A/B selector
+        try:
+            layout_metrics = bench_layout()
+            result.update(layout_metrics)
+            if result["value"] == 0.0 and \
+                    "resnet50_nhwc_images_per_sec" in layout_metrics:
+                result["metric"] = "resnet50_nhwc_images_per_sec"
+                result["unit"] = "images/sec"
+                result["value"] = layout_metrics[
+                    "resnet50_nhwc_images_per_sec"]
+        except Exception as e:  # noqa: BLE001
+            errors["layout"] = str(e)[:200]
     if which in ("default", "all", "trace"):
         try:
             result.update(bench_trace_opt())
@@ -1566,6 +1646,11 @@ def main():
         # the serving SLO numbers ride in counters too, so BENCH_*.json
         # trend tooling that only diffs the counters object sees them
         result["counters"]["serving"] = serving_metrics
+    if layout_metrics:
+        # layout A/B + seam-minimality evidence rides in counters too:
+        # a transpose-count creep is a bench_diff failure even when
+        # CPU-probe throughput noise hides the cost
+        result["counters"]["layout"] = layout_metrics
     try:
         # liveness-layer on-path overhead (note_step/emit/classify):
         # tracked per round so a regression onto the step path is a

@@ -48,16 +48,25 @@ from paddle_tpu.analysis.memory import (  # noqa: F401
     plan_donation,
     plan_memory,
     plan_remat,
+    replan_segments,
+)
+from paddle_tpu.analysis.layout import (  # noqa: F401
+    LayoutAssignPass,
+    LayoutPlan,
+    apply_layout,
+    plan_layout,
+    resolved_layout_mode,
 )
 
 __all__ = [
     "AnalysisContext", "DEFAULT_PASSES", "DiagnosticReport",
-    "DonationPlan", "Finding", "Graph", "LivenessReport", "MemoryPlan",
-    "OpNode", "PASS_REGISTRY", "Pass", "RematPlan", "Severity",
-    "TRANSFORM_PIPELINE", "TransformContext", "TransformPass",
-    "TransformReport", "VarNode", "VerificationError",
-    "analyze_liveness", "build_graph", "default_passes",
-    "optimize_program", "plan_donation", "plan_memory", "plan_remat",
-    "register_pass", "run_passes", "transform_passes", "verify_graph",
-    "verify_program",
+    "DonationPlan", "Finding", "Graph", "LayoutAssignPass", "LayoutPlan",
+    "LivenessReport", "MemoryPlan", "OpNode", "PASS_REGISTRY", "Pass",
+    "RematPlan", "Severity", "TRANSFORM_PIPELINE", "TransformContext",
+    "TransformPass", "TransformReport", "VarNode", "VerificationError",
+    "analyze_liveness", "apply_layout", "build_graph", "default_passes",
+    "optimize_program", "plan_donation", "plan_layout", "plan_memory",
+    "plan_remat", "register_pass", "replan_segments",
+    "resolved_layout_mode", "transform_passes", "run_passes",
+    "verify_graph", "verify_program",
 ]

@@ -40,7 +40,7 @@ from paddle_tpu.core.types import VarType, convert_dtype_to_np
 __all__ = [
     "LiveInterval", "LivenessReport", "DonationPlan", "RematPlan",
     "MemoryPlan", "analyze_liveness", "plan_donation", "plan_remat",
-    "plan_memory", "hbm_budget_bytes",
+    "replan_segments", "plan_memory", "hbm_budget_bytes",
 ]
 
 # Mirrors framework.OpRole (reference: op_proto_maker.h) without the
@@ -360,6 +360,68 @@ def plan_remat(graph, liveness, budget_bytes, max_segments=32):
         n, activation_bytes, est(n), candidates,
         "peak %s over budget %s -> %d segments (est %s%s)"
         % (_fmt_bytes(liveness.peak_bytes), _fmt_bytes(budget_bytes), n,
+           _fmt_bytes(est(n)), "" if fits else ", still over — clamped"))
+
+
+def replan_segments(plan, measured_bytes, budget_bytes, max_segments=32):
+    """Re-run the remat segment search with the cost model rescaled by
+    the REALIZED peak (the engine's ``memory_plan_delta`` measurement).
+
+    The static model under- or over-counts by whatever XLA's fusion and
+    scheduling actually did; the simplest measurement-driven correction
+    is a multiplicative one: scale every term of ``est(n) = base + 2A/n``
+    by ``ratio = measured / predicted`` so the model reproduces the
+    observation at the current segment count, then re-run the same
+    power-of-two search against the unchanged budget. Returns a
+    ``RematPlan`` whose ``est_peak_bytes`` is in MEASURED units; its
+    ``n_segments`` may be 0 (the realized peak fits without remat), equal
+    to the old count (measurement confirms the plan — caller should skip
+    the re-jit), or a different power of two."""
+    remat = plan.remat if isinstance(plan, MemoryPlan) else plan
+    predicted = (plan.predicted_peak_bytes
+                 if isinstance(plan, MemoryPlan)
+                 else remat.est_peak_bytes)
+    measured = int(measured_bytes)
+    if measured <= 0 or predicted <= 0:
+        return RematPlan(remat.n_segments, remat.activation_bytes,
+                         predicted, remat.candidates,
+                         "replan skipped: no usable measurement")
+    if budget_bytes is None or budget_bytes <= 0:
+        return RematPlan(remat.n_segments, remat.activation_bytes,
+                         predicted, remat.candidates,
+                         "replan skipped: no HBM budget")
+    ratio = float(measured) / float(predicted)
+    A = remat.activation_bytes
+    if A <= 0:
+        return RematPlan(0, 0, measured, remat.candidates,
+                         "replan: no rematerializable activations "
+                         "(measured %s)" % _fmt_bytes(measured))
+    # invert the current estimate back to the model's unsegmented peak,
+    # then rescale: est'(n) = ratio * (base + ceil(2A/n))
+    n_now = remat.n_segments
+    base = predicted - ((2 * A + n_now - 1) // n_now if n_now else A)
+    unsegmented = ratio * (base + A)
+
+    def est(n):
+        return int(ratio * (base + (2 * A + n - 1) // n))
+
+    if unsegmented <= budget_bytes:
+        return RematPlan(
+            0, A, int(unsegmented), remat.candidates,
+            "replan: measured %s (x%.2f of predicted) -> unsegmented "
+            "peak %s fits budget %s"
+            % (_fmt_bytes(measured), ratio, _fmt_bytes(int(unsegmented)),
+               _fmt_bytes(budget_bytes)))
+    n = 2
+    while n < max_segments and est(n) > budget_bytes:
+        n *= 2
+    n = min(n, max_segments)
+    fits = est(n) <= budget_bytes
+    return RematPlan(
+        n, A, est(n), remat.candidates,
+        "replan: measured %s vs predicted %s (x%.2f) -> %d segments "
+        "(est %s%s)"
+        % (_fmt_bytes(measured), _fmt_bytes(predicted), ratio, n,
            _fmt_bytes(est(n)), "" if fits else ", still over — clamped"))
 
 

@@ -59,6 +59,10 @@ TRANSFORM_PIPELINE = (
     "fuse-elemwise-act",
     "fold-constants",
     "cse",
+    # last: the whole-program NHWC rewrite (analysis/layout.py) wants the
+    # final op set — fusions done, dead constants folded — before it
+    # partitions the def-use graph and bakes weight layouts
+    "layout-assign",
 )
 
 
@@ -932,3 +936,8 @@ class CSEPass(TransformPass):
                 (k, repr(v)) for k, v in op.attrs.items()
                 if k not in _NONSEMANTIC_ATTRS and not k.startswith("__"))),
         )
+
+
+# Imported last so the layout pass can subclass TransformPass; the import
+# itself is what registers "layout-assign" in PASS_REGISTRY.
+from paddle_tpu.analysis import layout as _layout  # noqa: E402,F401

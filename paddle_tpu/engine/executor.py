@@ -82,6 +82,19 @@ class CompiledBlock:
         # once at the first run (goodput ledger / MFU attribution);
         # None until captured, 0.0 when the backend reports nothing
         self.flops = None
+        # measured-feedback re-planning (analysis/memory.replan_segments):
+        # replanned bounds the loop to ONE re-jit per cache entry;
+        # auto_remat_eligible mirrors the get_compiled auto-remat guard
+        # (no mesh/accumulation/test program/manual segments); _rebuild
+        # re-compiles with a new segment count; mem_budget is the HBM
+        # budget the plan was made against; _layout_scope pins the scope
+        # whose id() rides in the cache key under the layout pass
+        self.replanned = False
+        self.auto_remat_eligible = False
+        self.mem_budget = None
+        self._cache_key = None
+        self._rebuild = None
+        self._layout_scope = None
 
 
 class Engine:
@@ -224,7 +237,7 @@ class Engine:
             cache_key_extra=cache_key_extra, mesh=mesh,
             shard_rules=shard_rules, data_axes=data_axes,
             remat_segments=remat_segments, verify=verify,
-            opt_level=opt_level, sdc=sdc)
+            opt_level=opt_level, sdc=sdc, scope=scope)
 
         mutated = [self._state_value(scope, n) for n in compiled.mutated_names]
         readonly = [self._state_value(scope, n) for n in compiled.readonly_names]
@@ -385,6 +398,12 @@ class Engine:
                         delta_bytes=int(measured) - predicted,
                         remat_segments=compiled.remat_segments,
                         donated=len(compiled.mutated_names))
+                    # measured-feedback loop: a miss beyond the
+                    # replan_tolerance re-plans the segment count from
+                    # the realized peak and re-jits once (bounded by
+                    # compiled.replanned); the swapped executable serves
+                    # the NEXT step — this one already ran
+                    self._maybe_replan(compiled, int(measured))
             # Every step: live-buffer census (scope-resident params vs
             # transient feed/fetch/activation bytes), allocator stats,
             # watermark, and the edge-triggered memory_pressure event.
@@ -489,7 +508,7 @@ class Engine:
                      fetch_list, is_test, donate_state, amp,
                      accumulate_steps, cache_key_extra=None, mesh=None,
                      shard_rules=None, data_axes=("dp",), remat_segments=0,
-                     verify=None, opt_level=None, sdc=False):
+                     verify=None, opt_level=None, sdc=False, scope=None):
         """LRU-cached executable lookup/compile for one (program, feed
         signature) — shared by ``run_block`` and the Executor's
         ``cost_analysis`` so an analysis compiles exactly the executable
@@ -522,6 +541,18 @@ class Engine:
             from paddle_tpu.analysis import memory as memplan
 
             mem_budget = memplan.hbm_budget_bytes()
+        # The layout pass bakes weight values OIHW->HWIO in the SCOPE, so
+        # a layout-rewritten executable is only valid against the scope it
+        # was compiled for: key on (mode, scope identity). The compiled
+        # entry pins the scope object (below) so the id can never be
+        # recycled while the entry lives.
+        layout_key = None
+        if opt_level > 0:
+            from paddle_tpu.analysis.layout import resolved_layout_mode
+
+            mode = resolved_layout_mode(opt_level)
+            if mode is not None:
+                layout_key = (mode, id(scope) if scope is not None else None)
         key = (
             program_desc.cached_fingerprint(),
             block_idx,
@@ -538,6 +569,7 @@ class Engine:
             mesh_key,
             mem_budget,
             sdc,
+            layout_key,
         )
         compiled = self._cache.get(key)
         if compiled is None:
@@ -563,7 +595,8 @@ class Engine:
 
                     run_desc, _report = optimize_program(
                         program_desc, level=opt_level,
-                        feed_names=feed_names, fetch_names=fetch_list)
+                        feed_names=feed_names, fetch_names=fetch_list,
+                        scope=scope)
                 memory_plan, auto_remat = None, 0
                 if opt_level >= 3:
                     # Memory planning on the POST-transform desc (the
@@ -652,6 +685,29 @@ class Engine:
                             remat_segments=remat_segments,
                             memory_plan=memory_plan, sdc=sdc,
                         )
+            # measured-feedback re-planning metadata (_maybe_replan):
+            # eligible exactly where auto-remat was legal, with a rebuild
+            # closure that re-lowers the SAME post-transform desc at a
+            # new segment count — the layout/transform work is not redone
+            compiled.auto_remat_eligible = bool(
+                memory_plan is not None and not remat_segments
+                and accumulate_steps <= 1 and mesh is None and not is_test)
+            compiled.mem_budget = mem_budget
+            compiled._cache_key = key
+            if layout_key is not None:
+                compiled._layout_scope = scope
+
+            def _rebuild(new_segments, new_plan, _desc=run_desc):
+                return self._compile(
+                    _desc.block(block_idx), feed_names, fetch_list,
+                    is_test, donate_state, mesh=mesh,
+                    feed_values=feed_values, shard_rules=shard_rules,
+                    data_axes=data_axes, amp=amp,
+                    accumulate_steps=accumulate_steps,
+                    remat_segments=new_segments, memory_plan=new_plan,
+                    sdc=sdc)
+
+            compiled._rebuild = _rebuild
             # the cache-miss build (trace/transform/verify/lower) is
             # wall the step did not spend computing — charge it now so
             # the step-boundary mark books only the remainder as compute
@@ -664,6 +720,71 @@ class Engine:
             self._cache.move_to_end(key)
             obs.inc("engine.cache_hit")
         return compiled
+
+    def _maybe_replan(self, compiled, measured_bytes):
+        """Close the memory_plan_delta loop: when XLA's realized peak
+        misses the plan's prediction beyond PADDLE_TPU_REPLAN_TOLERANCE,
+        re-run the segment search with the cost model rescaled by the
+        measurement (analysis/memory.replan_segments) and re-jit ONCE,
+        swapping the cache entry so the next step runs the corrected
+        executable. Bounded: each entry re-plans at most once, and the
+        replacement is itself marked re-planned."""
+        from paddle_tpu import flags
+        from paddle_tpu.analysis import memory as memplan
+
+        tol = float(flags.get_flag("replan_tolerance"))
+        plan = compiled.memory_plan
+        if (tol <= 0 or compiled.replanned or plan is None
+                or measured_bytes <= 0 or not compiled.mem_budget
+                or not compiled.auto_remat_eligible
+                or compiled._rebuild is None):
+            return
+        compiled.replanned = True  # one attempt per entry, hit or miss
+        predicted = int(plan.predicted_peak_bytes)
+        if predicted > 0 and abs(measured_bytes - predicted) <= tol * predicted:
+            return
+        new_remat = memplan.replan_segments(
+            plan, measured_bytes, compiled.mem_budget)
+        if int(new_remat.n_segments) == int(compiled.remat_segments):
+            if obs.enabled():
+                obs.event("memory_replan_skipped",
+                          measured_bytes=int(measured_bytes),
+                          predicted_bytes=predicted,
+                          remat_segments=int(compiled.remat_segments),
+                          reason=new_remat.reason)
+            return
+        # never swap under in-flight deferred steps: they hold the old
+        # executable's donated buffers, so the window drains first
+        self.window.sync()
+        new_plan = memplan.MemoryPlan(plan.liveness, plan.donation,
+                                      new_remat)
+        try:
+            with obs.span("replan"), obs.time_block("engine.replan_ms"):
+                fresh = compiled._rebuild(int(new_remat.n_segments),
+                                          new_plan)
+        except NotImplementedError:
+            # same static rejections as the auto-remat path: keep the
+            # executable we measured
+            obs.inc("memory.replan_fallback")
+            return
+        fresh.replanned = True
+        fresh.auto_remat_eligible = False
+        fresh.mem_budget = compiled.mem_budget
+        fresh._cache_key = compiled._cache_key
+        fresh._rebuild = compiled._rebuild
+        fresh._layout_scope = compiled._layout_scope
+        key = compiled._cache_key
+        if self._cache.get(key) is compiled:
+            self._cache[key] = fresh
+        obs.inc("memory.replan")
+        if obs.enabled():
+            obs.event("memory_replan",
+                      measured_bytes=int(measured_bytes),
+                      predicted_bytes=predicted,
+                      old_segments=int(compiled.remat_segments),
+                      new_segments=int(new_remat.n_segments),
+                      est_peak_bytes=int(new_remat.est_peak_bytes),
+                      reason=new_remat.reason)
 
     @staticmethod
     def _state_value(scope, name):

@@ -39,8 +39,26 @@ DEFS = {
         "fusion, constant folding, and CSE, 3 = + memory planning "
         "(analysis/memory.py): liveness-driven state donation and "
         "automatic rematerialization under the HBM budget "
-        "(PADDLE_TPU_HBM_BUDGET_FRAC). Rewrites operate on a clone; "
-        "the program desc is never mutated."),
+        "(PADDLE_TPU_HBM_BUDGET_FRAC), 4 = + whole-program NHWC layout "
+        "assignment (analysis/layout.py) when PADDLE_TPU_LAYOUT is "
+        "'auto'. Rewrites operate on a clone; the program desc is never "
+        "mutated."),
+    "layout": (
+        str, "auto",
+        "Whole-program layout assignment (analysis/layout.py): rewrite "
+        "every conv/pool/batch_norm (and their grads) to NHWC, bake "
+        "OIHW filters to HWIO in the scope, and insert transpose2 seams "
+        "only at feed/fetch/flatten boundaries. 'auto' = on at opt_level "
+        ">= 4, 'nhwc' = on whenever transforms run, 'off' = never. The "
+        "engine keys its executable cache on the resolved value."),
+    "replan_tolerance": (
+        float, 0.0,
+        "Measured-feedback memory re-planning: when the realized XLA "
+        "peak (memory_plan_delta telemetry, first run of a planned "
+        "executable) misses the prediction by more than this relative "
+        "tolerance, re-plan the remat segment count from the measured "
+        "peak and re-jit once (bounded; counted in memory.replan). "
+        "Requires PADDLE_TPU_METRICS=1. <=0 disables."),
     "hbm_budget_frac": (
         float, 0.9,
         "Fraction of device memory (observability.memory."

@@ -48,7 +48,13 @@ def _conv2d_apply(x, w, attrs):
     dilations = tuple(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
     pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    # data_format NHWC = the layout-assignment pass (analysis/layout.py)
+    # rewrote this op; the filter arrives HWIO (baked into the scope)
+    if attrs.get("data_format", "NCHW") == "NHWC":
+        dims = ("NHWC", "HWIO", "NHWC")
+    else:
+        dims = ("NCHW", "OIHW", "NCHW")
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, dims)
     return lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
         dimension_numbers=dn, feature_group_count=groups,
@@ -79,11 +85,17 @@ def conv2d_grad(ctx, ins, attrs):
             "Filter@GRAD": [dw.astype(w.dtype)]}
 
 
+def _depthwise_groups(x, attrs):
+    # channel count lives last under the layout pass's NHWC rewrite
+    return x.shape[3] if attrs.get("data_format", "NCHW") == "NHWC" \
+        else x.shape[1]
+
+
 @register_no_grad_op("depthwise_conv2d_grad")
 def depthwise_conv2d_grad(ctx, ins, attrs):
     x = single(ins, "Input")
     attrs = dict(attrs)
-    attrs["groups"] = x.shape[1]
+    attrs["groups"] = _depthwise_groups(x, attrs)
     return conv2d_grad(ctx, ins, attrs)
 
 
@@ -91,7 +103,7 @@ def depthwise_conv2d_grad(ctx, ins, attrs):
 def depthwise_conv2d(ctx, ins, attrs):
     x = single(ins, "Input")
     attrs = dict(attrs)
-    attrs["groups"] = x.shape[1]
+    attrs["groups"] = _depthwise_groups(x, attrs)
     return conv2d(ctx, ins, attrs)
 
 
@@ -136,7 +148,7 @@ def conv2d_transpose(ctx, ins, attrs):
 
 @register_op("pool2d")
 def pool2d(ctx, ins, attrs):
-    x = single(ins, "X")  # NCHW
+    x = single(ins, "X")  # NCHW, or NHWC after the layout pass
     ptype = attrs.get("pooling_type", "max")
     ksize = attrs.get("ksize", [2, 2])
     strides = attrs.get("strides", [1, 1])
@@ -145,18 +157,24 @@ def pool2d(ctx, ins, attrs):
     exclusive = attrs.get("exclusive", True)
     adaptive = attrs.get("adaptive", False)
     ceil_mode = attrs.get("ceil_mode", False)
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    spatial = (1, 2) if nhwc else (2, 3)
 
     if global_pooling or (adaptive and list(ksize) == [1, 1]):
         if ptype == "max":
-            out = jnp.max(x, axis=(2, 3), keepdims=True)
+            out = jnp.max(x, axis=spatial, keepdims=True)
         else:
             # fp32 accumulation for low-precision (H*W-element sums)
-            out = jnp.mean(fp32_accum(x), axis=(2, 3),
+            out = jnp.mean(fp32_accum(x), axis=spatial,
                            keepdims=True).astype(x.dtype)
         return {"Out": [out]}
 
-    window = (1, 1, ksize[0], ksize[1])
-    strides_ = (1, 1, strides[0], strides[1])
+    if nhwc:
+        window = (1, ksize[0], ksize[1], 1)
+        strides_ = (1, strides[0], strides[1], 1)
+    else:
+        window = (1, 1, ksize[0], ksize[1])
+        strides_ = (1, 1, strides[0], strides[1])
     if ceil_mode:
         # pad right/bottom enough that the last partial window is included
         def _extra(in_sz, k, s, p):
@@ -164,16 +182,13 @@ def pool2d(ctx, ins, attrs):
             needed = (out_sz - 1) * s + k - in_sz - p
             return max(needed, p)
 
-        eh = _extra(x.shape[2], ksize[0], strides[0], paddings[0])
-        ew = _extra(x.shape[3], ksize[1], strides[1], paddings[1])
-        pads = ((0, 0), (0, 0), (paddings[0], eh), (paddings[1], ew))
+        eh = _extra(x.shape[spatial[0]], ksize[0], strides[0], paddings[0])
+        ew = _extra(x.shape[spatial[1]], ksize[1], strides[1], paddings[1])
+        sp = ((paddings[0], eh), (paddings[1], ew))
     else:
-        pads = (
-            (0, 0),
-            (0, 0),
-            (paddings[0], paddings[0]),
-            (paddings[1], paddings[1]),
-        )
+        sp = ((paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    pads = ((0, 0), sp[0], sp[1], (0, 0)) if nhwc \
+        else ((0, 0), (0, 0), sp[0], sp[1])
 
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min

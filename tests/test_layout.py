@@ -1,0 +1,301 @@
+"""Layout-assignment suite (analysis/layout.py + the engine's
+opt-level-4 / PADDLE_TPU_LAYOUT seam): flag gating, per-op propagation
+(must-rewrite and near-miss), transpose minimality on a hand-built
+conv chain and on the real ResNet cifar graph (seam count asserted),
+NCHW-vs-NHWC loss parity at identical seeds on ResNet and LeNet+Adam
+(weight + optimizer-twin baking checked in the scope), post-pass
+verifier cleanliness, and INT8 x layout composition (the quantized
+program predicts the same classes with the pass on)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags, models, nets
+from paddle_tpu import observability as obs
+from paddle_tpu.analysis import (
+    apply_layout,
+    plan_layout,
+    resolved_layout_mode,
+    verify_program,
+)
+from paddle_tpu.framework import Program, program_guard
+
+_ANCHORS = ("conv2d", "depthwise_conv2d", "quantized_conv2d", "pool2d",
+            "batch_norm")
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    for name in ("opt_level", "layout", "metrics"):
+        flags.reset_flag(name)
+
+
+# -- flag gating ------------------------------------------------------------
+def test_resolved_layout_mode_gating():
+    # explicit off wins at any level
+    flags.set_flags({"layout": "off"})
+    assert resolved_layout_mode(4) is None
+    # explicit nhwc wins at any level (the zero-code-change env spelling)
+    flags.set_flags({"layout": "nhwc"})
+    assert resolved_layout_mode(0) == "nhwc"
+    # auto: on at level >= 4 only
+    flags.set_flags({"layout": "auto"})
+    assert resolved_layout_mode(3) is None
+    assert resolved_layout_mode(4) == "nhwc"
+    # unknown spelling fails closed, never half-rewrites
+    flags.set_flags({"layout": "nchw4c"})
+    assert resolved_layout_mode(4) is None
+
+
+# -- hand-built chain: propagation + transpose minimality -------------------
+def _conv_chain():
+    """feed -> conv2d -> relu -> pool2d -> fetch: one NHWC island whose
+    only unresolvable boundaries are the protected feed and fetch."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        p = fluid.layers.pool2d(c, pool_size=2, pool_type="max")
+    return main, startup, p
+
+
+def test_chain_minimal_seams_and_colors():
+    main, _, p = _conv_chain()
+    plan = plan_layout(main.desc, feed_names=["x"], fetch_names=[p.name])
+    # exactly 2 seams: feed in, fetch out — relu rides inside the island
+    assert plan.transpose_count == 2
+    directions = sorted(d for _, d, _, _ in plan.seams)
+    assert directions == ["nchw->nhwc", "nhwc->nchw"]
+    ops = main.desc.block(0).ops
+    for idx, op in enumerate(ops):
+        if op.type in ("conv2d", "pool2d", "relu"):
+            assert plan.colors[idx] == "nhwc", op.type
+    # the conv filter is scheduled for OIHW->HWIO baking
+    (w_name,) = [op.input("Filter")[0] for op in ops
+                 if op.type == "conv2d"]
+    assert w_name in plan.weights
+
+
+def test_chain_apply_rewrites_attrs_weights_and_verifies():
+    main, startup, p = _conv_chain()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    work = main.desc.clone()
+    n, plan = apply_layout(work, feed_names=["x"], fetch_names=[p.name],
+                           scope=scope)
+    assert n > 0 and plan.skipped is None
+    ops = work.block(0).ops
+    for op in ops:
+        if op.type in ("conv2d", "pool2d"):
+            assert op.attrs["data_format"] == "NHWC"
+    seam_ops = [op for op in ops if op.type == "transpose2"
+                and "__layout_seam__" in op.attrs]
+    assert len(seam_ops) == plan.transpose_count == 2
+    # filter value physically HWIO in the scope, declared shape updated
+    (w_name,) = plan.weights
+    declared = plan.weights[w_name]
+    hwio = tuple(declared[i] for i in (2, 3, 1, 0))
+    assert tuple(np.asarray(scope.get(w_name)).shape) == hwio
+    assert w_name in scope._layout_hwio
+    vd = work.block(0).find_var_recursive(w_name)
+    assert tuple(vd.shape) == hwio
+    # the rewritten program is still statically clean
+    verify_program(work, feed_names=["x"], fetch_names=[p.name],
+                   raise_on_error=True)
+    # applying again on a fresh clone is idempotent against the baked
+    # scope: the checkpoint contract (a reloaded HWIO value is detected,
+    # not double-transposed)
+    work2 = main.desc.clone()
+    _, plan2 = apply_layout(work2, feed_names=["x"],
+                            fetch_names=[p.name], scope=scope)
+    assert tuple(np.asarray(scope.get(w_name)).shape) == hwio
+    assert not plan2.baked_now
+
+
+# -- near misses: the pass must decline, not half-rewrite -------------------
+def test_fetched_intermediate_stays_nchw():
+    """Fetching the conv output pins it to the feed/fetch contract: the
+    var may not be stored NHWC, so a seam cuts before the fetch."""
+    main, _, p = _conv_chain()
+    ops = main.desc.block(0).ops
+    (c_name,) = [op.output("Out")[0] for op in ops if op.type == "pool2d"]
+    # fetch BOTH the pool output and the conv pre-activation
+    (conv_out,) = [op.output("Output")[0] for op in ops
+                   if op.type == "conv2d"]
+    plan = plan_layout(main.desc, feed_names=["x"],
+                       fetch_names=[c_name, conv_out])
+    assert conv_out not in plan.nhwc_vars
+    assert c_name not in plan.nhwc_vars
+
+
+def test_rank2_program_declined():
+    """No 4D anchor: an MLP program takes zero rewrites (and reports
+    why) instead of growing speculative transposes."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="relu")
+    plan = plan_layout(main.desc, feed_names=["x"], fetch_names=[h.name])
+    assert plan.n_nhwc_ops == 0
+    assert plan.transpose_count == 0
+
+
+def test_conv2d_transpose_is_a_barrier():
+    """conv2d_transpose has no NHWC lowering here: it must stay NCHW
+    and force a seam rather than silently flip."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1)
+        up = fluid.layers.conv2d_transpose(c, num_filters=2,
+                                           filter_size=2, stride=2)
+    plan = plan_layout(main.desc, feed_names=["x"], fetch_names=[up.name])
+    ops = main.desc.block(0).ops
+    for idx, op in enumerate(ops):
+        if op.type == "conv2d_transpose":
+            assert plan.colors[idx] != "nhwc"
+
+
+# -- the real graphs: seam counts + training parity -------------------------
+def _resnet_tiny():
+    main, startup, h = models.resnet.get_model(batch_size=4,
+                                               dataset="cifar10", depth=20)
+    return main, startup, h
+
+
+def _resnet_feed(rng):
+    return {"img": rng.randn(4, 3, 32, 32).astype(np.float32),
+            "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+
+
+def _lenet():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c1 = nets.simple_img_conv_pool(
+            input=img, filter_size=5, num_filters=8, pool_size=2,
+            pool_stride=2, act="relu")
+        pred = fluid.layers.fc(input=c1, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    return main, startup, {"loss": loss, "pred": pred}
+
+
+def _lenet_feed(rng):
+    return {"img": rng.randn(4, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+
+
+def test_resnet_train_graph_seams_and_coverage():
+    """The acceptance shape on the real model: EVERY conv/BN/pool op
+    (forward and grad) lands NHWC and the whole train graph costs
+    exactly 3 transposes — img feed in, flatten boundary out before the
+    fc mul, and the flatten gradient back in before pool2d_grad."""
+    np.random.seed(11)
+    main, _, h = _resnet_tiny()
+    plan = plan_layout(main.desc, feed_names=["img", "label"],
+                       fetch_names=[h["loss"].name])
+    ops = main.desc.block(0).ops
+    anchor_idx = [i for i, op in enumerate(ops)
+                  if op.type in _ANCHORS
+                  or (op.type.endswith("_grad")
+                      and op.type[:-len("_grad")] in _ANCHORS)]
+    assert len(anchor_idx) > 50  # depth-20 resnet: fwd + bwd anchors
+    assert all(plan.colors[i] == "nhwc" for i in anchor_idx)
+    assert plan.transpose_count == 3
+    seam_dirs = sorted(d for _, d, _, _ in plan.seams)
+    assert seam_dirs == ["nchw->nhwc", "nchw->nhwc", "nhwc->nchw"]
+    # every conv filter (fwd ones) is scheduled for HWIO
+    filters = {op.input("Filter")[0] for op in ops if op.type == "conv2d"}
+    assert filters <= set(plan.weights)
+
+
+def _train(build, feed_fn, layout_mode, steps=3, seed=11):
+    flags.set_flags({"opt_level": 2, "layout": layout_mode,
+                     "metrics": True})
+    np.random.seed(seed)
+    main, startup, h = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            v = exe.run(main, feed=feed_fn(rng),
+                        fetch_list=[h["loss"]])
+            losses.append(float(np.asarray(v[0]).reshape(-1)[0]))
+    return losses, scope, main
+
+
+@pytest.mark.slow
+def test_resnet_nchw_vs_nhwc_loss_parity():
+    base, _, _ = _train(_resnet_tiny, _resnet_feed, "off")
+    before = obs.counter_value("layout.nhwc_ops")
+    nhwc, scope, _ = _train(_resnet_tiny, _resnet_feed, "nhwc")
+    # the pass really fired (not a silently-skipped NCHW run)
+    assert obs.counter_value("layout.nhwc_ops") > before
+    assert scope._layout_hwio  # weights physically HWIO in this scope
+    assert all(np.isfinite(v) for v in nhwc)
+    # same math, different layout: conv reassociation tolerance only
+    np.testing.assert_allclose(nhwc, base, rtol=2e-4, atol=1e-6)
+
+
+def test_lenet_adam_parity_and_optimizer_twin_baking():
+    base, _, _ = _train(_lenet, _lenet_feed, "off", steps=4)
+    nhwc, scope, main = _train(_lenet, _lenet_feed, "nhwc", steps=4)
+    np.testing.assert_allclose(nhwc, base, rtol=1e-5, atol=1e-7)
+    ops = main.desc.block(0).ops
+    (w_name,) = {op.input("Filter")[0] for op in ops
+                 if op.type == "conv2d"}
+    # the filter AND its Adam moments were baked together: a mixed-layout
+    # optimizer update (HWIO weight, OIHW moment) would silently corrupt
+    baked = scope._layout_hwio
+    assert w_name in baked
+    twins = [n for n in baked if n != w_name and n.startswith(w_name)]
+    assert len(twins) == 2, baked  # moment1 + moment2
+    w = np.asarray(scope.get(w_name))
+    for t in twins:
+        assert np.asarray(scope.get(t)).shape == w.shape
+
+
+def test_int8_quantized_program_parity_with_layout_on():
+    """Composition with PR 8: freeze -> calibrate -> quantize, then run
+    the int8 program NCHW vs layout-on — quantized_conv2d flips NHWC,
+    the int8 weight re-bakes, and the predictions match exactly."""
+    from paddle_tpu.inference import post_training_quantize
+
+    flags.set_flags({"opt_level": 2, "layout": "off"})
+    np.random.seed(11)
+    main, startup, h = _lenet()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, feed=_lenet_feed(rng), fetch_list=[h["loss"]])
+        batches = [_lenet_feed(rng) for _ in range(2)]
+        int8_prog, _, rep = post_training_quantize(
+            main, batches, feed_names=["img"],
+            fetch_names=[h["pred"].name], freeze_first=True)
+        assert rep.quantized
+        x = _lenet_feed(rng)
+        (p_nchw,) = exe.run(int8_prog, feed={"img": x["img"]},
+                            fetch_list=[h["pred"]])
+        flags.set_flags({"layout": "nhwc"})
+        (p_nhwc,) = exe.run(int8_prog, feed={"img": x["img"]},
+                            fetch_list=[h["pred"]])
+    np.testing.assert_allclose(np.asarray(p_nhwc), np.asarray(p_nchw),
+                               rtol=1e-5, atol=1e-6)
+    assert np.asarray(p_nhwc).argmax(-1).tolist() == \
+        np.asarray(p_nchw).argmax(-1).tolist()

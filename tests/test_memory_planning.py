@@ -14,10 +14,12 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu import flags, models, parallel
 from paddle_tpu.analysis import build_graph
 from paddle_tpu.analysis.memory import (
+    RematPlan,
     analyze_liveness,
     plan_donation,
     plan_memory,
     plan_remat,
+    replan_segments,
 )
 from paddle_tpu.framework import Program, program_guard
 
@@ -25,7 +27,8 @@ from paddle_tpu.framework import Program, program_guard
 @pytest.fixture(autouse=True)
 def _restore_flags():
     yield
-    for name in ("opt_level", "device_memory_bytes", "hbm_budget_frac"):
+    for name in ("opt_level", "device_memory_bytes", "hbm_budget_frac",
+                 "replan_tolerance", "metrics", "dispatch_steps"):
         flags.reset_flag(name)
 
 
@@ -294,3 +297,166 @@ def test_opt3_passes_post_pass_verification():
         (l,) = exe.run(main, feed=_mlp_feed(np.random.RandomState(0)),
                        fetch_list=[loss], verify=True)
     assert np.isfinite(float(np.asarray(l).reshape(-1)[0]))
+
+
+# -- measured-feedback re-planning (engine._maybe_replan) -------------------
+def _seed_measurement(monkeypatch, value):
+    """Make XLA's post-compile memory measurement 'observe' a fixed
+    peak: the engine reads it through obs.memory.record_compile_memory
+    at the once-per-executable seam, so patching the module attr seeds
+    a predicted-vs-measured miss without touching the engine."""
+    from paddle_tpu import observability as obs
+
+    monkeypatch.setattr(obs.memory, "record_compile_memory",
+                        lambda *a, **k: int(value))
+
+
+def _replan_train(steps=4, dispatch_steps=None):
+    np.random.seed(11)
+    main, startup, h = _resnet_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (l,) = exe.run(main, feed=_resnet_feed(rng),
+                           fetch_list=[h["loss"]],
+                           dispatch_steps=dispatch_steps)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        exe.sync()
+    return losses, exe
+
+
+def test_replan_segments_rescales_cost_model():
+    """Pure cost-model unit (no jit): the measurement rescales
+    est(n) = base + 2A/n multiplicatively, so an overcounting static
+    model collapses to 0 segments, a confirming measurement keeps the
+    count, and an undercounting one escalates it (capped)."""
+    # static model: A = 1 MiB activations, 4 segments, predicted peak
+    # base + ceil(2A/4) = 1.5 MiB over a 2 MiB budget
+    A = 1 << 20
+    plan = RematPlan(4, A, (1 << 20) + (2 * A + 3) // 4, [], "unit")
+    # measured far below prediction: unsegmented peak fits -> 0 segments
+    low = replan_segments(plan, 64 << 10, 2 << 20)
+    assert low.n_segments == 0
+    assert low.est_peak_bytes <= 2 << 20
+    # measured == predicted against the budget the plan was made for:
+    # the search re-lands on the same count (caller skips the re-jit)
+    same = replan_segments(plan, plan.est_peak_bytes, plan.est_peak_bytes)
+    assert same.n_segments == plan.n_segments
+    # measured far above: more segments, capped at max_segments
+    high = replan_segments(plan, 64 << 20, 1 << 20, max_segments=8)
+    assert plan.n_segments < high.n_segments <= 8
+    # degenerate inputs fall back to the existing plan, never crash
+    assert replan_segments(plan, 0, 1 << 20).n_segments == 4
+    assert replan_segments(plan, 1 << 20, 0).n_segments == 4
+
+
+@pytest.mark.slow
+def test_replan_closes_seeded_miss_with_one_rejit(monkeypatch):
+    """The 2 MiB budget makes auto-remat segment the step; a seeded
+    measurement far BELOW prediction (the static model overcounted)
+    must re-plan to the unsegmented executable: exactly one re-jit,
+    cache entry swapped, memory.replan telemetry, losses still finite
+    and on the opt-2 trajectory."""
+    from paddle_tpu import observability as obs
+
+    flags.set_flags({"opt_level": 3, "device_memory_bytes": 2 << 20,
+                     "metrics": True, "replan_tolerance": 0.25})
+    _seed_measurement(monkeypatch, 64 << 10)  # 64 KiB: fits any budget
+    c0 = obs.counter_value("memory.replan")
+    losses, exe = _replan_train()
+    assert obs.counter_value("memory.replan") == c0 + 1
+    entries = list(exe.engine._cache.values())
+    planned = [c for c in entries if c.memory_plan is not None
+               and "img" in c.block_program.feed_names]
+    assert planned
+    # the remat executable was REPLACED: the measurement said the
+    # activations fit, so no segment survives in the cache
+    assert all(c.remat_segments == 0 for c in planned)
+    assert all(c.replanned for c in planned)
+    assert all(np.isfinite(v) for v in losses)
+    # parity with the unplanned trajectory: the swap changed memory
+    # strategy, not math
+    flags.reset_flag("replan_tolerance")
+    l2, _ = _train_model(_resnet_tiny, _resnet_feed, 2, steps=4)
+    np.testing.assert_allclose(losses, l2, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_replan_is_bounded_to_one_attempt(monkeypatch):
+    """The fresh executable is itself marked re-planned: its own
+    first-run measurement (still seeded to miss) must NOT trigger a
+    second re-jit, however many steps follow."""
+    from paddle_tpu import observability as obs
+
+    flags.set_flags({"opt_level": 3, "device_memory_bytes": 2 << 20,
+                     "metrics": True, "replan_tolerance": 0.25})
+    _seed_measurement(monkeypatch, 64 << 10)
+    c0 = obs.counter_value("memory.replan")
+    losses, exe = _replan_train(steps=6)
+    assert obs.counter_value("memory.replan") == c0 + 1
+    assert len(losses) == 6
+
+
+@pytest.mark.slow
+def test_replan_respects_default_tolerance_off(monkeypatch):
+    """replan_tolerance defaults to 0 = feedback loop disarmed: the
+    same seeded miss changes nothing."""
+    from paddle_tpu import observability as obs
+
+    flags.set_flags({"opt_level": 3, "device_memory_bytes": 2 << 20,
+                     "metrics": True})
+    _seed_measurement(monkeypatch, 64 << 10)
+    c0 = obs.counter_value("memory.replan")
+    _, exe = _replan_train(steps=2)
+    assert obs.counter_value("memory.replan") == c0
+    planned = [c for c in exe.engine._cache.values()
+               if c.memory_plan is not None]
+    assert any(c.remat_segments > 0 for c in planned)  # remat kept
+
+
+@pytest.mark.slow
+def test_replan_drains_dispatch_window_before_swap(monkeypatch):
+    """Under dispatch_steps=4 the swap may not happen beneath in-flight
+    steps (they hold the old executable's donated buffers): the engine
+    must drain via window.sync first, and the windowed trajectory stays
+    bit-exact with the depth-1 one (same executables, same rng)."""
+    from paddle_tpu import observability as obs
+
+    flags.set_flags({"opt_level": 3, "device_memory_bytes": 2 << 20,
+                     "metrics": True, "replan_tolerance": 0.25})
+    _seed_measurement(monkeypatch, 64 << 10)
+    l1, _ = _replan_train(steps=4, dispatch_steps=1)
+
+    _seed_measurement(monkeypatch, 64 << 10)
+    np.random.seed(11)
+    main, startup, h = _resnet_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    syncs_at_replan = []
+    orig_sync = exe.engine.window.sync
+
+    def spy_sync():
+        syncs_at_replan.append(obs.counter_value("memory.replan"))
+        return orig_sync()
+
+    monkeypatch.setattr(exe.engine.window, "sync", spy_sync)
+    c0 = obs.counter_value("memory.replan")
+    deferred = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(4):
+            (l,) = exe.run(main, feed=_resnet_feed(rng),
+                           fetch_list=[h["loss"]], dispatch_steps=4)
+            deferred.append(l)
+        exe.sync()
+    l4 = [float(np.asarray(v).reshape(-1)[0]) for v in deferred]
+    assert obs.counter_value("memory.replan") == c0 + 1
+    # at least one full drain was taken BEFORE the counter bumped —
+    # i.e. the sync preceded the swap, not the other way around
+    assert any(v == c0 for v in syncs_at_replan)
+    assert l4 == l1

@@ -17,8 +17,8 @@ Direction is inferred from the key name: throughput-like suffixes
 (``*_per_sec``, ``*speedup*``, ``*qps*``, ``*hit*``, ``*goodput*``,
 ``*frac``, ``*mfu*``) are higher-better; cost-like ones (``*_ms``,
 ``*_bytes``, ``*miss*``, ``*evict*``, ``*trips*``, ``*crashes*``,
-``*_wall*``) are lower-better; anything else is informational (printed
-under --all, never a failure). Both file shapes are accepted: the raw
+``*_wall*``, ``*transpose*``) are lower-better; anything else is
+informational (printed under --all, never a failure). Both file shapes are accepted: the raw
 ``bench.py`` stdout JSON and the archived ``{"cmd", "rc", "parsed"}``
 wrapper the rounds are stored as.
 """
@@ -30,7 +30,7 @@ HIGHER = ("per_sec", "per_s", "speedup", "qps", "hit", "goodput",
           "frac", "mfu", "fill", "efficiency", "max_batch")
 LOWER = ("_ms", "_bytes", "_ns", "miss", "evict", "trips", "crashes",
          "wall", "dropped", "failed", "skew", "spread", "overhead",
-         "badput", "retries")
+         "badput", "retries", "transpose")
 
 
 def direction(key):

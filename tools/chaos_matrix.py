@@ -17,6 +17,9 @@ prove the whole failure-domain story at once:
               persistent)                      vote, blame, quarantine
     preempt   SIGTERM eviction                 drain + checkpoint + free
                                                restart (no budget spent)
+    layout    rank loss mid-window with the    shrink + replay stays
+              NHWC layout pass rewriting the   bit-exact with HWIO-baked
+              conv probe (PADDLE_TPU_LAYOUT)   weights in the checkpoints
 
 Usage::
 
@@ -58,6 +61,11 @@ GATES = [
                 "disk_fail@rank0:step12;worker_kill@rank0:step14"]),
     ("sdc", ["--sdc"]),
     ("preempt", ["--preempt"]),
+    # conv probe + whole-program NHWC rewrite (analysis/layout.py): the
+    # baked-HWIO filter rides the checkpoints through a permanent rank
+    # loss mid dispatch window — the layout pass may not perturb
+    # bit-exact replay under any recovery path
+    ("layout", ["--layout", "--shrink", "--dispatch-steps", "4"]),
 ]
 
 

@@ -23,7 +23,10 @@ reads "off"). ``--freeze`` additionally runs each built model through
 the inference freeze + INT8 post-training-quantization pipeline
 (paddle_tpu.inference) and prints the op/var counts before/after, the
 batch-norm folds, and the quantized-vs-skipped table with per-op
-calibrated ranges. Exit code 1 iff any ERROR finding.
+calibrated ranges. ``--layout`` additionally prints each program's NHWC
+layout-assignment plan (analysis/layout.py, dry run): the ops assigned
+NHWC, every transpose2 seam and where it lands, and the weights that
+would be re-laid-out OIHW->HWIO. Exit code 1 iff any ERROR finding.
 
   python tools/lint_program.py
   python tools/lint_program.py --list-passes
@@ -154,6 +157,20 @@ def _print_memory_plan(program_or_desc, args, fetch_names=None):
     print(plan.render())
 
 
+def _print_layout_plan(program_or_desc, feed_names=None, fetch_names=None):
+    """The --layout report: dry-run the NHWC layout-assignment partition
+    (analysis/layout.py plan_layout — no desc mutation, no scope) and
+    print what the engine's opt-level-4 compile would do: which ops take
+    NHWC, every transpose2 seam and the op it feeds, and the weights
+    that would be re-laid-out OIHW->HWIO."""
+    from paddle_tpu.analysis.layout import plan_layout
+
+    plan = plan_layout(program_or_desc, feed_names=feed_names or (),
+                       fetch_names=fetch_names or ())
+    print("-- layout report (NHWC assignment, dry run) --")
+    print(plan.render())
+
+
 def _freeze_report(main, startup, feed_names, fetch_names):
     """The --freeze report: run the real freeze + PTQ pipeline
     (inference/freeze.py, inference/quantize.py) over the built model and
@@ -229,6 +246,9 @@ def _lint_built_model(name, builder, args):
         report.extend(startup_report.findings)
         if args.memory:
             _print_memory_plan(main_desc, args, fetch_names=fetches)
+        if args.layout:
+            _print_layout_plan(main_desc, feed_names=feeds,
+                               fetch_names=fetches)
         if args.freeze:
             try:
                 _freeze_report(main, startup, feeds, [fetch.name])
@@ -268,6 +288,8 @@ def _lint_file(path, args):
                             shard_rules=_parse_rules(args.rule))
     if args.memory:
         _print_memory_plan(program, args)
+    if args.layout:
+        _print_layout_plan(program)
     min_sev = Severity.INFO if args.verbose else Severity.WARNING
     print(report.render(min_severity=min_sev))
     return report
@@ -305,6 +327,12 @@ def main(argv=None):
                         help="HBM budget for the --memory remat policy "
                              "(default: device limit x "
                              "PADDLE_TPU_HBM_BUDGET_FRAC, if knowable)")
+    parser.add_argument("--layout", action="store_true",
+                        help="print each program's NHWC layout-"
+                             "assignment plan (analysis/layout.py dry "
+                             "run): ops assigned NHWC, transpose seams "
+                             "and where they land, weights re-laid-out "
+                             "OIHW->HWIO")
     parser.add_argument("--freeze", action="store_true",
                         help="after linting each built model, run the "
                              "inference freeze + INT8 PTQ pipeline over "
