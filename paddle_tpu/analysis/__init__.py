@@ -50,6 +50,13 @@ from paddle_tpu.analysis.memory import (  # noqa: F401
     plan_remat,
     replan_segments,
 )
+from paddle_tpu.analysis.spmd import (  # noqa: F401
+    Collective,
+    SpmdReport,
+    analyze_spmd,
+    hlo_collectives,
+    measured_collectives,
+)
 from paddle_tpu.analysis.layout import (  # noqa: F401
     LayoutAssignPass,
     LayoutPlan,
@@ -64,6 +71,8 @@ __all__ = [
     "LivenessReport", "MemoryPlan", "OpNode", "PASS_REGISTRY", "Pass",
     "RematPlan", "Severity", "TRANSFORM_PIPELINE", "TransformContext",
     "TransformPass", "TransformReport", "VarNode", "VerificationError",
+    "Collective", "SpmdReport", "analyze_spmd", "hlo_collectives",
+    "measured_collectives",
     "analyze_liveness", "apply_layout", "build_graph", "default_passes",
     "optimize_program", "plan_donation", "plan_layout", "plan_memory",
     "plan_remat", "register_pass", "replan_segments",

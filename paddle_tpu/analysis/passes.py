@@ -96,6 +96,12 @@ DEFAULT_PASSES = (
     "grad-pairing",
     "dead-op",
     "sharding",
+    # SPMD layer (analysis/spmd.py, registered on package import like
+    # memory.py's checker); all three no-op when ctx.mesh is None, so
+    # single-device verify pays nothing.
+    "spmd-unsharded-param",
+    "spmd-replication-blowup",
+    "spmd-collective-report",
 )
 
 

@@ -404,6 +404,49 @@ class Engine:
                     # compiled.replanned); the swapped executable serves
                     # the NEXT step — this one already ran
                     self._maybe_replan(compiled, int(measured))
+                spmd_plan = getattr(compiled, "spmd_plan", None)
+                if (mesh is not None and spmd_plan is not None
+                        and not spmd_plan.empty
+                        and _flags.get_flag("spmd_predict")):
+                    # Collective-schedule analog of memory_plan_delta:
+                    # parse the HLO of the executable that just ran
+                    # (lower() hits jax's caches — a retrace, not a
+                    # second XLA compile) and hold the static prediction
+                    # accountable against the partitioner's actual
+                    # collectives.
+                    try:
+                        from paddle_tpu.analysis import (
+                            spmd as spmd_analysis)
+
+                        hlo = compiled.jitted.lower(
+                            feed_values, mutated, readonly,
+                            rng_seed).compile().as_text()
+                        meas = spmd_analysis.measured_collectives(hlo)
+                        obs.set_gauge("spmd.predicted_psums",
+                                      spmd_plan.psum_count)
+                        obs.set_gauge("spmd.measured_psums",
+                                      meas["psum_count"])
+                        obs.set_gauge("spmd.predicted_collective_bytes",
+                                      spmd_plan.total_bytes)
+                        obs.set_gauge("spmd.measured_collective_bytes",
+                                      meas["total_bytes"])
+                        obs.event(
+                            "spmd.prediction_delta",
+                            psums_predicted=spmd_plan.psum_count,
+                            psums_measured=meas["psum_count"],
+                            all_gathers_predicted=(
+                                spmd_plan.all_gather_count),
+                            all_gathers_measured=(
+                                meas["all_gather_count"]),
+                            bytes_predicted=spmd_plan.total_bytes,
+                            bytes_measured=meas["total_bytes"],
+                            bytes_delta=(meas["total_bytes"]
+                                         - spmd_plan.total_bytes),
+                            peak_bytes_predicted=int(
+                                spmd_plan.per_device_peak_bytes),
+                            peak_bytes_measured=int(measured or 0))
+                    except Exception:
+                        obs.inc("spmd.predict_crashes")
             # Every step: live-buffer census (scope-resident params vs
             # transient feed/fetch/activation bytes), allocator stats,
             # watermark, and the edge-triggered memory_pressure event.
@@ -689,6 +732,40 @@ class Engine:
             # eligible exactly where auto-remat was legal, with a rebuild
             # closure that re-lowers the SAME post-transform desc at a
             # new segment count — the layout/transform work is not redone
+            # Static SPMD plan on the POST-transform desc (mesh compiles
+            # only), crash-isolated like the memory planner: the
+            # predicted collective schedule rides on the executable and
+            # is validated against the jitted HLO on its first run
+            # (spmd.prediction_delta — see _run_block_impl).
+            compiled.spmd_plan = None
+            if mesh is not None:
+                from paddle_tpu.analysis import spmd as spmd_analysis
+
+                try:
+                    with obs.span("spmd-plan"), \
+                            obs.time_block("engine.spmd_plan_ms"):
+                        compiled.spmd_plan = spmd_analysis.analyze_spmd(
+                            run_desc, mesh=mesh,
+                            shard_rules=shard_rules,
+                            data_axes=data_axes,
+                            feed_names=feed_names,
+                            feed_shapes={
+                                n: tuple(v.shape) for n, v in
+                                zip(feed_names, feed_values)},
+                            fetch_names=fetch_list,
+                            block_idx=block_idx)
+                    if obs.enabled() and compiled.spmd_plan is not None:
+                        plan = compiled.spmd_plan
+                        obs.event(
+                            "spmd_plan",
+                            psums=plan.psum_count,
+                            all_gathers=plan.all_gather_count,
+                            collective_bytes=plan.total_bytes,
+                            per_device_peak_bytes=int(
+                                plan.per_device_peak_bytes))
+                except Exception:
+                    obs.inc("spmd.plan_crashes")
+                    compiled.spmd_plan = None
             compiled.auto_remat_eligible = bool(
                 memory_plan is not None and not remat_segments
                 and accumulate_steps <= 1 and mesh is None and not is_test)

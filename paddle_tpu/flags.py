@@ -15,7 +15,7 @@ Usage, mirroring the reference's fluid.core.globals-style access::
 import os
 
 __all__ = ["DEFS", "get_flag", "set_flags", "reset_flag", "describe",
-           "env_name", "on_change"]
+           "env_name", "on_change", "flags_doc_issues"]
 
 # name -> (type, default, help)
 DEFS = {
@@ -59,6 +59,15 @@ DEFS = {
         "tolerance, re-plan the remat segment count from the measured "
         "peak and re-jit once (bounded; counted in memory.replan). "
         "Requires PADDLE_TPU_METRICS=1. <=0 disables."),
+    "spmd_predict": (
+        bool, False,
+        "Validate the static SPMD collective schedule "
+        "(analysis/spmd.py) against the compiled executable on the "
+        "first run of every mesh-compiled block: parse the jitted HLO, "
+        "compare predicted psum/all-gather counts and payload bytes, "
+        "and emit spmd.prediction_delta telemetry — the collective-"
+        "schedule analog of memory_plan_delta. Requires "
+        "PADDLE_TPU_METRICS=1; no-op without a mesh."),
     "hbm_budget_frac": (
         float, 0.9,
         "Fraction of device memory (observability.memory."
@@ -440,3 +449,36 @@ def describe():
             src = "default"
         out[name] = (get_flag(name), src, help_text)
     return out
+
+
+def flags_doc_issues(readme_path=None):
+    """Cross-reference the README flags table against DEFS: every
+    registered flag needs a documented row, every row a live flag, no
+    flag documented twice. Returns a list of human-readable issue
+    strings (empty = in sync) — shared by ``tests/test_flags_doc.py``
+    and ``tools/lint_program.py --flags``, so the table cannot drift
+    silently again."""
+    import re
+
+    if readme_path is None:
+        readme_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "README.md")
+    try:
+        with open(readme_path, "r") as f:
+            text = f.read()
+    except OSError as e:
+        return ["README not readable at %s: %s" % (readme_path, e)]
+    rows = re.findall(r"^\|\s*`([A-Za-z0-9_]+)`\s*\|", text, re.M)
+    documented = set(rows)
+    issues = []
+    for name in sorted(set(DEFS) - documented):
+        issues.append("flag %r (default %r) is registered in flags.py "
+                      "but has no row in the README flags table"
+                      % (name, DEFS[name][1]))
+    for name in sorted(documented - set(DEFS)):
+        issues.append("README flags table documents %r but flags.py "
+                      "registers no such flag (stale row)" % name)
+    for name in sorted(n for n in documented if rows.count(n) > 1):
+        issues.append("README flags table documents %r %d times"
+                      % (name, rows.count(name)))
+    return issues

@@ -20,6 +20,7 @@ from paddle_tpu.parallel.mesh import (  # noqa: F401
     set_default_mesh,
 )
 from paddle_tpu.parallel.sharding import (  # noqa: F401
+    Coverage,
     ShardingRules,
     batch_sharding,
 )

@@ -8,10 +8,19 @@ each PARAMETER is laid out; XLA's partitioner derives every gradient
 collective (all-reduce for replicated, reduce-scatter for sharded) from the
 layout — the scaling-book recipe."""
 
+import collections
 import re
 import warnings
 
 from jax.sharding import NamedSharding, PartitionSpec
+
+Coverage = collections.namedtuple(
+    "Coverage", ["matched", "unmatched", "rules_unused"])
+Coverage.__doc__ = """Rule-table coverage of a program's trainable
+parameters: ``matched`` {param: pattern}, ``unmatched`` [param],
+``rules_unused`` [pattern] — the shared evidence behind both the runtime
+``sharding.unmatched_param`` warning and the static
+``spmd-unsharded-param`` lint checker."""
 
 
 class ShardingRules:
@@ -56,14 +65,24 @@ class ShardingRules:
             (pat.pattern, tuple(str(e) for e in spec))
             for pat, spec in self._rules)
 
-    def spec_for(self, name, ndim=None, warn_unmatched=False):
+    def match(self, name):
+        """First-match-wins lookup: the (compiled_pattern, spec) pair
+        that decides ``name``, or None when unmatched (replicated).
+        ``spec_for`` and ``coverage`` both resolve through here."""
         for pat, spec in self._rules:
             if pat.search(name):
-                if ndim is not None and len(spec) > ndim:
-                    raise ValueError(
-                        "sharding rule %r has rank %d > var %r rank %d"
-                        % (pat.pattern, len(spec), name, ndim))
-                return spec
+                return pat, spec
+        return None
+
+    def spec_for(self, name, ndim=None, warn_unmatched=False):
+        hit = self.match(name)
+        if hit is not None:
+            pat, spec = hit
+            if ndim is not None and len(spec) > ndim:
+                raise ValueError(
+                    "sharding rule %r has rank %d > var %r rank %d"
+                    % (pat.pattern, len(spec), name, ndim))
+            return spec
         if warn_unmatched and self._rules and name not in self._warned:
             self._warned.add(name)
             from paddle_tpu import observability as obs
@@ -75,6 +94,29 @@ class ShardingRules:
                 "be replicated on every device" % name, RuntimeWarning,
                 stacklevel=2)
         return PartitionSpec()
+
+    def coverage(self, program_or_desc):
+        """Audit the rule table against a program's trainable
+        parameters: which rule decides each param, which params fall
+        through to replication, and which rules never fire. Accepts a
+        Program, a ProgramDescData, or an analysis Graph."""
+        desc = getattr(program_or_desc, "desc", program_or_desc)
+        desc = getattr(desc, "program_desc", desc)  # analysis Graph
+        matched, unmatched = {}, []
+        used = set()
+        for bd in desc.blocks:
+            for vd in bd.vars.values():
+                if not getattr(vd, "is_parameter", False):
+                    continue
+                hit = self.match(vd.name)
+                if hit is None:
+                    unmatched.append(vd.name)
+                else:
+                    matched[vd.name] = hit[0].pattern
+                    used.add(hit[0].pattern)
+        rules_unused = [pat.pattern for pat, _ in self._rules
+                        if pat.pattern not in used]
+        return Coverage(matched, sorted(set(unmatched)), rules_unused)
 
     def sharding_for(self, mesh, name, value=None):
         ndim = getattr(value, "ndim", None)

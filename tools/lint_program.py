@@ -26,7 +26,16 @@ batch-norm folds, and the quantized-vs-skipped table with per-op
 calibrated ranges. ``--layout`` additionally prints each program's NHWC
 layout-assignment plan (analysis/layout.py, dry run): the ops assigned
 NHWC, every transpose2 seam and where it lands, and the weights that
-would be re-laid-out OIHW->HWIO. Exit code 1 iff any ERROR finding.
+would be re-laid-out OIHW->HWIO. ``--spmd`` additionally prints each
+program's static SPMD report (analysis/spmd.py) under the --mesh/--rule
+table: sharding table, predicted collective schedule with bytes,
+per-device peak vs replicated peak, and the replicated-optimizer-state
+(ZeRO-1) ledger. ``--flags`` cross-references the README flags table
+against the flags.py DEFS registry and exits 1 on missing/stale rows.
+Exit code 1 iff any ERROR finding.
+
+  python tools/lint_program.py --model mnist_mlp --spmd --mesh dp=2
+  python tools/lint_program.py --flags
 
   python tools/lint_program.py
   python tools/lint_program.py --list-passes
@@ -74,16 +83,24 @@ def _load_book_builders():
     return builders
 
 
-def _parse_mesh(spec):
-    """'dp=4,tp=2' -> Mesh (over however many host devices exist)."""
+def _parse_mesh_axes(spec):
+    """'dp=4,tp=2' -> {'dp': 4, 'tp': 2} (static; no devices)."""
     if not spec:
         return None
-    from paddle_tpu.parallel.mesh import make_mesh
-
     axes = {}
     for part in spec.split(","):
         name, _, size = part.partition("=")
         axes[name.strip()] = int(size)
+    return axes
+
+
+def _parse_mesh(spec):
+    """'dp=4,tp=2' -> Mesh (over however many host devices exist)."""
+    axes = _parse_mesh_axes(spec)
+    if axes is None:
+        return None
+    from paddle_tpu.parallel.mesh import make_mesh
+
     return make_mesh(axes)
 
 
@@ -171,6 +188,52 @@ def _print_layout_plan(program_or_desc, feed_names=None, fetch_names=None):
     print(plan.render())
 
 
+def _print_spmd_report(program_or_desc, args, feed_names=None,
+                       fetch_names=None):
+    """The --spmd report: the static SPMD analysis (analysis/spmd.py)
+    under the --mesh/--rule table — sharding table, predicted collective
+    schedule with per-collective bytes, per-device peak vs replicated
+    peak, and the replicated-optimizer-state (ZeRO-1) ledger. Feed
+    shapes come from the desc with dynamic dims resolved to --batch."""
+    from paddle_tpu.analysis.spmd import analyze_spmd
+
+    # analyze_spmd is purely static — a {axis: size} dict is enough, no
+    # devices are ever touched for the report itself
+    mesh = _parse_mesh_axes(args.mesh) or {"dp": 2}
+    rules = _parse_rules(args.rule)
+    desc = getattr(program_or_desc, "desc", program_or_desc)
+    gb = desc.block(0)
+    feed_shapes = {}
+    for n in (feed_names or ()):
+        vd = gb.find_var_recursive(n)
+        if vd is not None and vd.shape is not None:
+            feed_shapes[n] = tuple(
+                args.batch if int(d) < 0 else int(d) for d in vd.shape)
+    report = analyze_spmd(desc, mesh=mesh, shard_rules=rules,
+                          feed_names=feed_names,
+                          feed_shapes=feed_shapes,
+                          fetch_names=fetch_names)
+    print("-- spmd report --")
+    print(report.render())
+
+
+def _flags_doc_lint():
+    """The --flags mode: cross-reference the README flags table against
+    the flags.py DEFS registry (flags.flags_doc_issues) and fail on any
+    missing, stale, or duplicated row."""
+    from paddle_tpu import flags
+
+    issues = flags.flags_doc_issues()
+    if not issues:
+        print("flags doc: README table and flags.py DEFS are in sync "
+              "(%d flags)" % len(flags.DEFS))
+        return 0
+    for issue in issues:
+        print("flags doc: %s" % issue)
+    print("\nflags doc: %d issue(s)" % len(issues))
+    return 1
+
+
 def _freeze_report(main, startup, feed_names, fetch_names):
     """The --freeze report: run the real freeze + PTQ pipeline
     (inference/freeze.py, inference/quantize.py) over the built model and
@@ -249,6 +312,9 @@ def _lint_built_model(name, builder, args):
         if args.layout:
             _print_layout_plan(main_desc, feed_names=feeds,
                                fetch_names=fetches)
+        if args.spmd:
+            _print_spmd_report(main_desc, args, feed_names=feeds,
+                               fetch_names=fetches)
         if args.freeze:
             try:
                 _freeze_report(main, startup, feeds, [fetch.name])
@@ -290,6 +356,8 @@ def _lint_file(path, args):
         _print_memory_plan(program, args)
     if args.layout:
         _print_layout_plan(program)
+    if args.spmd:
+        _print_spmd_report(program, args)
     min_sev = Severity.INFO if args.verbose else Severity.WARNING
     print(report.render(min_severity=min_sev))
     return report
@@ -339,6 +407,20 @@ def main(argv=None):
                              "it and print the op/var before/after "
                              "counts, BN folds, and the quantized-vs-"
                              "skipped table with calibrated ranges")
+    parser.add_argument("--spmd", action="store_true",
+                        help="print each program's static SPMD report "
+                             "(analysis/spmd.py) under --mesh/--rule "
+                             "(default mesh dp=2): sharding table, "
+                             "predicted collective schedule with bytes, "
+                             "per-device peak vs replicated peak, and "
+                             "the replicated-optimizer-state ledger")
+    parser.add_argument("--batch", type=int, default=8, metavar="N",
+                        help="batch size used to resolve dynamic feed "
+                             "dims for --spmd (default 8)")
+    parser.add_argument("--flags", action="store_true",
+                        help="cross-reference the README flags table "
+                             "against the flags.py DEFS registry and "
+                             "exit 1 on missing/stale/duplicate rows")
     parser.add_argument("--list-passes", action="store_true",
                         help="list every registered pass (name, kind, "
                              "default on/off) and exit")
@@ -354,6 +436,21 @@ def main(argv=None):
     if args.list_passes:
         _list_passes()
         return 0
+
+    if args.flags:
+        return _flags_doc_lint()
+
+    if args.mesh:
+        # a Mesh over N>1 axes needs N host devices; force them before
+        # jax initializes (lint never touches real accelerators)
+        total = 1
+        for size in (_parse_mesh_axes(args.mesh) or {}).values():
+            total *= max(size, 1)
+        if total > 1 and "xla_force_host_platform_device_count" \
+                not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=%d" % total)
 
     if args.timing:
         from paddle_tpu import observability

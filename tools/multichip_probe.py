@@ -25,9 +25,19 @@ shared-capacity stand-in. ``--efficiency-floor F`` exits non-zero when
 the largest-N efficiency lands below F — the CI guard for "the psum
 path stopped scaling".
 
+``--predict`` additionally turns on the engine's SPMD prediction seam
+(PADDLE_TPU_SPMD_PREDICT) in every child: the first run of each mesh
+executable parses its own jitted HLO and emits a
+``spmd.prediction_delta`` span into the sink; the parent prints the
+predicted-vs-measured collective counts/bytes and per-device peak next
+to the scaling table. ``--predict-tolerance F`` makes it a CI gate:
+exit non-zero when any device count's psum count mismatches or its
+collective bytes miss by more than the relative tolerance.
+
 Usage:
   python tools/multichip_probe.py --model mlp --devices 1,2,4,8
   python tools/multichip_probe.py --model bert --efficiency-floor 0.6
+  python tools/multichip_probe.py --predict --predict-tolerance 0.1
 Bench integration: ``PADDLE_TPU_BENCH=multichip python bench.py`` calls
 ``probe_scaling()`` when fewer than 2 real devices exist.
 """
@@ -143,10 +153,31 @@ def _read_sink_gauges(path):
     return gauges
 
 
+def _read_sink_span(path, name):
+    """Last "span" event named ``name`` from a JSONL sink file; returns
+    its args dict (or None). The prediction seam emits exactly one
+    ``spmd.prediction_delta`` per compiled executable."""
+    args = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("t") == "span" and ev.get("name") == name:
+                    args = ev.get("args") or args
+    except OSError:
+        return None
+    return args
+
+
 def probe_scaling(model="mlp", devices=(1, 2, 4, 8), batch_per_device=64,
-                  steps=12, warmup=3, sink_dir=None):
-    """Run the sweep; returns {n: samples_per_sec}. Parent-side only."""
+                  steps=12, warmup=3, sink_dir=None, predict=False):
+    """Run the sweep; returns {n: samples_per_sec} (plus
+    {n: prediction_delta args} when ``predict``). Parent-side only."""
     results = {}
+    predictions = {}
     own_tmp = sink_dir is None
     if own_tmp:
         sink_dir = tempfile.mkdtemp(prefix="multichip_probe_")
@@ -159,6 +190,8 @@ def probe_scaling(model="mlp", devices=(1, 2, 4, 8), batch_per_device=64,
                             % n).strip()
         env["PADDLE_TPU_METRICS"] = "1"
         env["PADDLE_TPU_METRICS_SINK"] = sink
+        if predict:
+            env["PADDLE_TPU_SPMD_PREDICT"] = "1"
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         cmd = [sys.executable, os.path.abspath(__file__), "--child",
                "--model", model, "--batch-per-device",
@@ -176,6 +209,12 @@ def probe_scaling(model="mlp", devices=(1, 2, 4, 8), batch_per_device=64,
         else:  # sink missing/rotated away — fall back to the stdout line
             last = [l for l in r.stdout.splitlines() if l.strip()][-1]
             results[n] = float(json.loads(last)["samples_per_sec"])
+        if predict:
+            delta = _read_sink_span(sink, "spmd.prediction_delta")
+            if delta is not None:
+                predictions[n] = delta
+    if predict:
+        return results, predictions
     return results
 
 
@@ -202,6 +241,17 @@ def main(argv=None):
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--efficiency-floor", type=float, default=0.0,
                     help="exit 1 if the largest-N efficiency is below this")
+    ap.add_argument("--predict", action="store_true",
+                    help="enable the engine's SPMD prediction seam in "
+                         "every child and print predicted-vs-measured "
+                         "collective counts/bytes and per-device peak "
+                         "next to the scaling table")
+    ap.add_argument("--predict-tolerance", type=float, default=None,
+                    metavar="F",
+                    help="CI gate for --predict: exit 1 when any device "
+                         "count's psum count mismatches or collective "
+                         "bytes miss by more than this relative "
+                         "tolerance (e.g. 0.1)")
     ap.add_argument("--sink-dir", default=None,
                     help="directory for the per-run telemetry sinks "
                          "(default: a fresh temp dir)")
@@ -213,8 +263,16 @@ def main(argv=None):
         return 0
 
     devices = tuple(int(d) for d in args.devices.split(","))
-    results = probe_scaling(args.model, devices, args.batch_per_device,
-                            args.steps, args.warmup, args.sink_dir)
+    predict = args.predict or args.predict_tolerance is not None
+    predictions = {}
+    if predict:
+        results, predictions = probe_scaling(
+            args.model, devices, args.batch_per_device, args.steps,
+            args.warmup, args.sink_dir, predict=True)
+    else:
+        results = probe_scaling(args.model, devices,
+                                args.batch_per_device, args.steps,
+                                args.warmup, args.sink_dir)
     rows = efficiency_table(results)
     print("%-8s %-18s %s" % ("devices", "samples/sec", "efficiency"))
     for n, t, eff in rows:
@@ -225,13 +283,49 @@ def main(argv=None):
                "efficiency": {str(n): round(eff, 4)
                               for n, _, eff in rows if eff is not None}}
     print(json.dumps(summary))
+    rc = 0
+    if predict:
+        print("\n%-8s %-16s %-26s %-8s %s"
+              % ("devices", "psums p/m", "coll bytes p/m", "ratio",
+                 "peak bytes p/m"))
+        for n in sorted(results):
+            d = predictions.get(n)
+            if d is None:  # dp=1: no collectives, no seam event
+                print("%-8d %-16s %-26s %-8s %s" % (n, "-", "-", "-", "-"))
+                continue
+            bp, bm = d["bytes_predicted"], d["bytes_measured"]
+            ratio = (bm / bp) if bp else float("nan")
+            print("%-8d %-16s %-26s %-8s %s" % (
+                n,
+                "%d/%d" % (d["psums_predicted"], d["psums_measured"]),
+                "%d/%d" % (bp, bm), "%.3f" % ratio,
+                "%d/%d" % (d["peak_bytes_predicted"],
+                           d["peak_bytes_measured"])))
+            if args.predict_tolerance is not None:
+                if d["psums_predicted"] != d["psums_measured"]:
+                    sys.stderr.write(
+                        "predict gate: psum count %d != measured %d at "
+                        "%d devices\n" % (d["psums_predicted"],
+                                          d["psums_measured"], n))
+                    rc = 1
+                if bp and abs(ratio - 1.0) > args.predict_tolerance:
+                    sys.stderr.write(
+                        "predict gate: collective bytes off by %.1f%% "
+                        "(> %.1f%%) at %d devices\n"
+                        % (abs(ratio - 1.0) * 100,
+                           args.predict_tolerance * 100, n))
+                    rc = 1
+        if args.predict_tolerance is not None and not predictions:
+            sys.stderr.write("predict gate: no spmd.prediction_delta "
+                             "events found in any child sink\n")
+            rc = 1
     if rows and rows[-1][2] is not None \
             and rows[-1][2] < args.efficiency_floor:
         sys.stderr.write(
             "scaling efficiency %.3f at %d devices below floor %.3f\n"
             % (rows[-1][2], rows[-1][0], args.efficiency_floor))
-        return 1
-    return 0
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
