@@ -490,15 +490,27 @@ def bench_multichip(device_counts=(1, 2, 4, 8), steps=12, warmup=3):
     tput(N)/(N × tput(1)) on real devices; on the virtual-CPU fallback
     (flagged by ``multichip_virtual_cpu_devices``) the probe's
     shared-capacity normalization tput(N)/tput(1), since N forced-host
-    devices split one physical CPU and can never show N×."""
+    devices split one physical CPU and can never show N×.
+
+    The replicated-vs-sharded A/B: each model re-runs at the largest N
+    with the ZeRO-1 sharded weight update on
+    (``*_zero1_dp{n}_*`` / ``*_zero1_scaling_efficiency``), sweeps the
+    gradient-reduce bucket size under it
+    (``*_overlap_bucket{B}mb_dp{n}_*``), and reports the optimizer-state
+    bytes the sharded update reclaims per device
+    (``*_zero1_savings_bytes``, from the static SPMD ledger)."""
     import jax
+
+    from paddle_tpu import flags
 
     out = {}
     n_real = len(jax.devices())
     counts = [n for n in device_counts if n <= n_real]
+    bucket_sweep_mb = (1, 8)
     if len(counts) >= 2:
         import paddle_tpu.fluid as fluid
         from paddle_tpu import models
+        from paddle_tpu.analysis.spmd import analyze_spmd
         from paddle_tpu.parallel import ShardingRules, make_mesh
 
         on_tpu = jax.default_backend() != "cpu"
@@ -533,31 +545,61 @@ def bench_multichip(device_counts=(1, 2, 4, 8), steps=12, warmup=3):
             return main, startup, h["loss"], feed
 
         jobs["bert"] = (per_bert, "samples_per_sec", bert)
+
+        def measure(build, batch, n):
+            main, startup, loss, feed = build(batch)
+            mesh = make_mesh({"dp": n}, devices=jax.devices()[:n])
+            feed = {k: jax.device_put(v) for k, v in feed.items()}
+            exe = fluid.Executor()
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                step = lambda: exe.run(
+                    main, feed=feed, fetch_list=[loss], mesh=mesh,
+                    shard_rules=ShardingRules(),
+                    return_numpy=False)[0]
+                tput, lv = _throughput(step, batch, steps, warmup)
+            assert np.isfinite(lv)
+            return tput, main, feed
+
         for name, (per_dev, unit, build) in jobs.items():
             tputs = {}
             for n in counts:
-                batch = per_dev * n
-                main, startup, loss, feed = build(batch)
-                mesh = make_mesh({"dp": n}, devices=jax.devices()[:n])
-                feed = {k: jax.device_put(v) for k, v in feed.items()}
-                exe = fluid.Executor()
-                scope = fluid.Scope()
-                with fluid.scope_guard(scope):
-                    exe.run(startup)
-                    step = lambda: exe.run(
-                        main, feed=feed, fetch_list=[loss], mesh=mesh,
-                        shard_rules=ShardingRules(),
-                        return_numpy=False)[0]
-                    tput, lv = _throughput(step, batch, steps, warmup)
-                assert np.isfinite(lv)
+                tput, _, _ = measure(build, per_dev * n, n)
                 tputs[n] = tput
                 out["%s_dp%d_%s" % (name, n, unit)] = round(tput, 2)
             top = max(tputs)
             out["%s_scaling_efficiency" % name] = round(
                 tputs[top] / (top * tputs[1]), 4)
+            # the A/B: sharded update (+ bucket sweep) at the top count
+            flags.set_flags({"zero": True})
+            try:
+                ztput, main, feed = measure(build, per_dev * top, top)
+                out["%s_zero1_dp%d_%s" % (name, top, unit)] = round(
+                    ztput, 2)
+                out["%s_zero1_scaling_efficiency" % name] = round(
+                    ztput / (top * tputs[1]), 4)
+                for b in bucket_sweep_mb:
+                    flags.set_flags({"grad_bucket_mb": float(b)})
+                    btput, _, _ = measure(build, per_dev * top, top)
+                    out["%s_overlap_bucket%dmb_dp%d_%s"
+                        % (name, b, top, unit)] = round(btput, 2)
+            finally:
+                flags.reset_flag("zero")
+                flags.reset_flag("grad_bucket_mb")
+            base_rep = analyze_spmd(
+                main.desc, mesh={"dp": top},
+                shard_rules=ShardingRules(),
+                feed_shapes={k: tuple(np.asarray(v).shape)
+                             for k, v in feed.items()})
+            out["%s_zero1_savings_bytes" % name] = \
+                base_rep.opt_state.zero1_savings_bytes
     else:
         # single-chip host: forced-host-device CPU probe in subprocesses
-        from tools.multichip_probe import efficiency_table, probe_scaling
+        from paddle_tpu.analysis.spmd import analyze_spmd
+        from paddle_tpu.parallel import ShardingRules
+        from tools.multichip_probe import (_build, efficiency_table,
+                                           probe_scaling)
 
         for name, model, unit in (("resnet50", "resnet50",
                                    "images_per_sec"),
@@ -568,6 +610,32 @@ def bench_multichip(device_counts=(1, 2, 4, 8), steps=12, warmup=3):
             for n, t, _ in rows:
                 out["%s_dp%d_%s" % (name, n, unit)] = round(t, 2)
             out["%s_scaling_efficiency" % name] = round(rows[-1][2], 4)
+            # the A/B at the largest count: sharded update + one
+            # bucketed run, normalized against the replicated tput(1)
+            top = rows[-1][0]
+            base1 = rows[0][1]
+            ztput = probe_scaling(
+                model=model, devices=(top,), batch_per_device=8,
+                steps=steps, warmup=warmup, zero1=True)[top]
+            out["%s_zero1_dp%d_%s" % (name, top, unit)] = round(
+                ztput, 2)
+            out["%s_zero1_scaling_efficiency" % name] = round(
+                ztput / base1, 4) if base1 else None
+            for b in bucket_sweep_mb:
+                btput = probe_scaling(
+                    model=model, devices=(top,), batch_per_device=8,
+                    steps=steps, warmup=warmup, zero1=True,
+                    bucket_mb=float(b))[top]
+                out["%s_overlap_bucket%dmb_dp%d_%s"
+                    % (name, b, top, unit)] = round(btput, 2)
+            main, _, _, feed = _build(model, 8 * top)
+            base_rep = analyze_spmd(
+                main.desc, mesh={"dp": top},
+                shard_rules=ShardingRules(),
+                feed_shapes={k: tuple(np.asarray(v).shape)
+                             for k, v in feed.items()})
+            out["%s_zero1_savings_bytes" % name] = \
+                base_rep.opt_state.zero1_savings_bytes
         out["multichip_virtual_cpu_devices"] = 1
     out["multichip_device_counts"] = list(counts if len(counts) >= 2
                                           else device_counts)

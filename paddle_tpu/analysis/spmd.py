@@ -147,6 +147,17 @@ def _axes_of(dims):
     return tuple(axes)
 
 
+def _dims_to_pspec(dims):
+    """Per-dim axis tuples back into a PartitionSpec (the inverse of
+    ``_spec_dims``) — the form ``parallel.sharding.zero1_extend_spec``
+    takes, so the analyzer runs the engine's placement rule verbatim."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*[
+        ((tuple(e) if len(e) > 1 else e[0]) if e else None)
+        for e in (dims or ())])
+
+
 def _dims_str(dims):
     if not dims or not any(dims):
         return "replicated"
@@ -238,6 +249,7 @@ class SpmdReport:
         self.replicated_peak_bytes = 0
         self.opt_state = OptStateReport([], 1)
         self.suppressed_dead = 0  # collectives not emitted: op was dead
+        self.zero1 = False       # analyzed under the sharded update?
 
     @property
     def empty(self):
@@ -320,6 +332,10 @@ class SpmdReport:
                _fmt_bytes(self.replicated_peak_bytes),
                (self.replicated_peak_bytes
                 / max(self.per_device_peak_bytes, 1))))
+        if self.zero1:
+            lines.append(
+                "ZeRO-1 sharded update: ON — slots partitioned over "
+                "the data axes; the ledger below is post-sharding")
         lines.append(self.opt_state.render())
         for var, dim, a, b, op_type in self.conflicts[:top]:
             lines.append("conflict: %s dim %d wants %s vs %s (at %s)"
@@ -341,7 +357,8 @@ class _Propagator:
     the ``_op_*`` methods, dispatched by name."""
 
     def __init__(self, graph, mesh_axes, shard_rules, data_axes,
-                 feed_names, feed_shapes, fetch_names, block_idx=0):
+                 feed_names, feed_shapes, fetch_names, block_idx=0,
+                 zero1=False):
         self.graph = graph
         self.mesh_axes = mesh_axes
         self.rules = shard_rules
@@ -351,6 +368,10 @@ class _Propagator:
         self.fetch_names = (None if fetch_names is None
                             else list(fetch_names))
         self.block_idx = block_idx
+        self.zero1 = bool(zero1)
+        self.zero_params = {}  # param -> extended dims (update shard)
+        self.zero_grads = {}   # grad var -> dims (constraint point)
+        self.zero_slots = {}   # slot var -> dims (partitioned state)
         self.default_dim = max(
             (int(s[0]) for s in self.feed_shapes.values()
              if len(s) and int(s[0]) > 0), default=1)
@@ -482,10 +503,71 @@ class _Propagator:
             elif v.persistable:
                 self.set_spec(v, ())
 
+    # -- ZeRO-1 seeding ----------------------------------------------------
+    def _seed_zero1(self):
+        """Mirror of ``parallel.sharding.zero1_plan`` over the def-use
+        graph — the SAME placement rule (``zero1_extend_spec``) the
+        engine compiles with, so the predicted schedule is the compiled
+        one: slot vars (moments, velocity) are re-seeded with the data
+        axes extended onto the first divisible dim (the opt-state
+        ledger then reads ~zero), each param grad is marked for the
+        reduce-scatter constraint, and the param itself keeps its base
+        layout — the replicated ParamOut is what the update all-gathers
+        back into (emitted in ``_optimizer_op``)."""
+        from paddle_tpu.core.types import VarType
+        from paddle_tpu.parallel.sharding import (
+            ZERO1_EXCLUDED_GRAD_OPS,
+            ZERO1_REPLICATED_GRAD_OPS,
+            zero1_extend_spec,
+        )
+
+        for op in self.graph.block_ops(self.block_idx):
+            if op.type in SKIP_OPS or not (op.role() & _ROLE_OPTIMIZE):
+                continue
+            param, grad = self._in(op, "Param"), self._in(op, "Grad")
+            if (param is None or grad is None or param.desc is None
+                    or param.desc.shape is None):
+                continue
+            gt = getattr(grad.desc, "type", None) \
+                if grad.desc is not None else None
+            if gt is not None and int(gt) == int(VarType.SELECTED_ROWS):
+                continue  # sparse grads keep the replicated path
+            gw = set(w.type for w in grad.writers)
+            if gw & ZERO1_EXCLUDED_GRAD_OPS:
+                continue  # batch-norm updates stay replicated
+            shape = tuple(param.desc.shape)
+            zspec = zero1_extend_spec(
+                _dims_to_pspec(self.specs.get(param.name, ())), shape,
+                self.data_axes, self.mesh_axes)
+            if zspec is None:
+                continue
+            zdims = _spec_dims(zspec, len(shape))
+            self.zero_params[param.name] = zdims
+            # scatter-add grads are pinned replicated (see
+            # ZERO1_REPLICATED_GRAD_OPS); only the slots + update shard
+            self.zero_grads[grad.name] = (
+                () if gw & ZERO1_REPLICATED_GRAD_OPS else zdims)
+            for slot, v in op.in_edges:
+                if slot in ("Param", "Grad") or v.name in self.zero_slots:
+                    continue
+                if (v.desc is None or not v.persistable
+                        or getattr(v.desc, "is_parameter", False)
+                        or v.desc.shape is None):
+                    continue
+                sspec = zero1_extend_spec(
+                    _dims_to_pspec(self.specs.get(v.name, ())),
+                    tuple(v.desc.shape), self.data_axes, self.mesh_axes)
+                if sspec is not None:
+                    sdims = _spec_dims(sspec, len(v.desc.shape))
+                    self.zero_slots[v.name] = sdims
+                    self.set_spec(v, sdims)
+
     # -- walk --------------------------------------------------------------
     def run(self):
         self._compute_live()
         self._seed()
+        if self.zero1:
+            self._seed_zero1()
         for op in self.graph.block_ops(self.block_idx):
             if op.type in SKIP_OPS:
                 continue
@@ -494,6 +576,17 @@ class _Propagator:
         return self.report
 
     def _apply(self, op):
+        self._dispatch(op)
+        if self.zero_grads:
+            # ZeRO-1 constraint points: the engine pins every planned
+            # grad to its extended spec wherever an op (re)binds that
+            # name, so any op writing it leaves it reduce-scattered
+            for _, v in op.out_edges:
+                zd = self.zero_grads.get(v.name)
+                if zd is not None:
+                    self.set_spec(v, zd)
+
+    def _dispatch(self, op):
         t = op.type
         if t.endswith("_grad"):
             self._grad_op(op)
@@ -578,7 +671,12 @@ class _Propagator:
 
     def _optimizer_op(self, op):
         """ParamOut/MomentOut etc. keep their paired input's sharding
-        (the update is elementwise on each shard)."""
+        (the update is elementwise on each shard). Under the ZeRO-1
+        sharded update the param's grad and slots arrive dp-sharded
+        while ParamOut stays replicated (the engine's out_shardings) —
+        the partitioner closes that gap with ONE all-gather per updated
+        param, operand = the updated shard (validated against compiled
+        HLO; combined gathers keep the count via n_operands)."""
         in_by_slot = dict((s, v) for s, v in op.in_edges)
         for slot, v in op.out_edges:
             src = None
@@ -587,6 +685,15 @@ class _Propagator:
             if src is None:
                 src = in_by_slot.get("Param")
             self.set_spec(v, self.spec(src) if src is not None else ())
+        param = in_by_slot.get("Param")
+        zdims = (self.zero_params.get(param.name)
+                 if param is not None else None)
+        if zdims is not None:
+            axes = tuple(sorted(set(_axes_of(zdims))
+                                - set(_axes_of(self.spec(param)))))
+            self.emit(op, "all_gather", axes, param.name,
+                      self.nbytes_of(param, dims=zdims), "optimize",
+                      "ZeRO-1 update all-gathers the param shard")
 
     def _grad_op(self, op):
         """Gradients are isomorphic to their forward vars: spec(X@GRAD)
@@ -710,6 +817,11 @@ class _Propagator:
                     self.emit(op, "psum", tuple(sorted(stat_axes)),
                               v.name, self.nbytes_of(v, dims=(chan,)),
                               "forward", "sync batch_norm %s" % which)
+
+    # sync_batch_norm is batch_norm with the cross-replica statistics
+    # made explicit in the op type; under GSPMD both lower identically,
+    # so they share the prediction rule.
+    _op_sync_batch_norm = _op_batch_norm
 
     def _op_layer_norm(self, op):
         x = self._in(op, "X")
@@ -1019,11 +1131,15 @@ def _opt_state_report(graph, specs, mesh_axes, data_axes, feed_shapes,
 
 def analyze_spmd(program_or_desc, mesh=None, shard_rules=None,
                  data_axes=("dp",), feed_names=None, feed_shapes=None,
-                 fetch_names=None, block_idx=0):
+                 fetch_names=None, block_idx=0, zero1=False):
     """Whole-program SPMD analysis -> SpmdReport (see module docstring).
     ``mesh`` may be a jax Mesh, a {axis: size} dict, or a
     mesh_signature tuple; None (or an all-1 mesh) returns an empty
-    report. Purely static: no devices, no tracing, no XLA."""
+    report. ``zero1=True`` analyzes the program under the engine's
+    ZeRO-1 weight-update sharding (PADDLE_TPU_ZERO): optimizer slots
+    partitioned over the data axes, one all-gather per sharded param
+    update, and the opt-state ledger post-sharding. Purely static: no
+    devices, no tracing, no XLA."""
     mesh_axes = _mesh_axes(mesh)
     if not mesh_axes or all(s <= 1 for s in mesh_axes.values()):
         return SpmdReport({})
@@ -1034,8 +1150,9 @@ def analyze_spmd(program_or_desc, mesh=None, shard_rules=None,
         feed_names = list(feed_shapes)
     prop = _Propagator(graph, mesh_axes, shard_rules, data_axes,
                        feed_names, feed_shapes, fetch_names,
-                       block_idx=block_idx)
+                       block_idx=block_idx, zero1=zero1)
     report = prop.run()
+    report.zero1 = prop.zero1 and bool(prop.zero_params)
     base, sharded = _sharded_liveness(
         graph, report.shardings, mesh_axes, prop.feed_shapes,
         prop.default_dim)
@@ -1135,7 +1252,8 @@ def _ctx_report(graph, ctx):
         data_axes=ctx.data_axes,
         feed_names=(list(ctx.feed_names) if ctx.feed_names else None),
         fetch_names=(list(ctx.fetch_names)
-                     if ctx.fetch_names is not None else None))
+                     if ctx.fetch_names is not None else None),
+        zero1=getattr(ctx, "zero1", False))
     ctx._spmd_report = report
     return report
 

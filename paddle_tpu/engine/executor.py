@@ -570,11 +570,20 @@ class Engine:
         if mesh is not None:
             from paddle_tpu.parallel.mesh import mesh_signature
 
+            # ZeRO-1 weight-update sharding gate: training-step compiles
+            # on the plain lower_block path only (the scan/remat
+            # lowerings keep the replicated update). Both knobs key the
+            # cache so toggling them never serves a stale executable.
+            zero = (bool(flags.get_flag("zero")) and not is_test
+                    and accumulate_steps <= 1 and not remat_segments)
+            grad_bucket_mb = (float(flags.get_flag("grad_bucket_mb"))
+                              if zero else 0.0)
             mesh_key = (mesh_signature(mesh),
                         shard_rules.signature()
                         if shard_rules is not None else None,
-                        tuple(data_axes))
+                        tuple(data_axes), zero, grad_bucket_mb)
         else:
+            zero, grad_bucket_mb = False, 0.0
             mesh_key = None
         # Level-3 plans depend on the HBM budget (device limit × budget
         # frac), so the budget is part of the key: retuning the budget
@@ -708,6 +717,8 @@ class Engine:
                             accumulate_steps=accumulate_steps,
                             remat_segments=remat_segments or auto_remat,
                             memory_plan=memory_plan, sdc=sdc,
+                            zero=zero and not auto_remat,
+                            grad_bucket_mb=grad_bucket_mb,
                         )
                     except NotImplementedError:
                         # the remat lowering statically rejects some
@@ -727,6 +738,7 @@ class Engine:
                             accumulate_steps=accumulate_steps,
                             remat_segments=remat_segments,
                             memory_plan=memory_plan, sdc=sdc,
+                            zero=zero, grad_bucket_mb=grad_bucket_mb,
                         )
             # measured-feedback re-planning metadata (_maybe_replan):
             # eligible exactly where auto-remat was legal, with a rebuild
@@ -753,7 +765,7 @@ class Engine:
                                 n: tuple(v.shape) for n, v in
                                 zip(feed_names, feed_values)},
                             fetch_names=fetch_list,
-                            block_idx=block_idx)
+                            block_idx=block_idx, zero1=zero)
                     if obs.enabled() and compiled.spmd_plan is not None:
                         plan = compiled.spmd_plan
                         obs.event(
@@ -782,7 +794,8 @@ class Engine:
                     data_axes=data_axes, amp=amp,
                     accumulate_steps=accumulate_steps,
                     remat_segments=new_segments, memory_plan=new_plan,
-                    sdc=sdc)
+                    sdc=sdc, zero=zero and not new_segments,
+                    grad_bucket_mb=grad_bucket_mb)
 
             compiled._rebuild = _rebuild
             # the cache-miss build (trace/transform/verify/lower) is
@@ -878,7 +891,8 @@ class Engine:
     def _compile(self, block, feed_names, fetch_list, is_test, donate_state,
                  mesh=None, feed_values=None, shard_rules=None,
                  data_axes=("dp",), amp=False, accumulate_steps=1,
-                 remat_segments=0, memory_plan=None, sdc=False):
+                 remat_segments=0, memory_plan=None, sdc=False,
+                 zero=False, grad_bucket_mb=0.0):
         if accumulate_steps > 1 and remat_segments:
             raise NotImplementedError(
                 "accumulate_steps and remat_segments cannot combine yet; "
@@ -914,6 +928,24 @@ class Engine:
         bp = BlockProgram(block, feed_names,
                           list(fetch_list) + sdc_grad_names, (),
                           extra_live_vars=extra_live)
+        # ZeRO-1 plan (mesh training compiles on the plain path only):
+        # which params' updates shard over the data axes, which slot
+        # vars live partitioned, and where the grads get constrained so
+        # the partitioner reduce-scatters instead of all-reducing
+        zplan = None
+        if (zero and mesh is not None and not is_test
+                and accumulate_steps <= 1 and not remat_segments):
+            from paddle_tpu.parallel.sharding import zero1_plan
+
+            zplan = zero1_plan(block, mesh.shape, data_axes=data_axes,
+                               shard_rules=shard_rules)
+            if not zplan.param_specs:
+                zplan = None
+            elif obs.enabled():
+                obs.event("zero1_plan",
+                          params=len(zplan.param_specs),
+                          slots=len(zplan.slot_specs),
+                          bucket_mb=float(grad_bucket_mb))
         if accumulate_steps > 1:
             from paddle_tpu.engine.lowering import lower_block_accumulated
 
@@ -927,7 +959,16 @@ class Engine:
                 bp, remat_segments, is_test=is_test, executor=self,
                 amp=amp)
         else:
-            fn = lower_block(bp, is_test=is_test, executor=self, amp=amp)
+            grad_sh = None
+            if zplan is not None:
+                from jax.sharding import NamedSharding as _NS
+
+                grad_sh = {n: _NS(mesh, spec)
+                           for n, spec in zplan.grad_specs.items()}
+            fn = lower_block(
+                bp, is_test=is_test, executor=self, amp=amp,
+                grad_shardings=grad_sh,
+                grad_bucket_bytes=int(float(grad_bucket_mb) * 2 ** 20))
 
         out_set = set(bp.state_out_names)
         mutated = [n for n in bp.state_in_names if n in out_set]
@@ -1022,6 +1063,14 @@ class Engine:
             rep = NamedSharding(mesh, P())
 
             def state_sharding(name):
+                # ZeRO-1 slot override: optimizer-state vars (moments,
+                # velocity) live dp-partitioned, in AND out (the update
+                # ops write the same var name in place, so one
+                # name-keyed lookup covers both sides). Params are NOT
+                # in slot_specs — their replicated out_sharding is what
+                # makes the partitioner all-gather the updated shard.
+                if zplan is not None and name in zplan.slot_specs:
+                    return NamedSharding(mesh, zplan.slot_specs[name])
                 if shard_rules is None:
                     return rep
                 vd = block.find_var_recursive(name)

@@ -68,6 +68,32 @@ DEFS = {
         "and emit spmd.prediction_delta telemetry — the collective-"
         "schedule analog of memory_plan_delta. Requires "
         "PADDLE_TPU_METRICS=1; no-op without a mesh."),
+    "zero": (
+        bool, False,
+        "ZeRO-1 weight-update sharding over the mesh's data axes "
+        "(engine cache-miss seam, mesh compiles only): optimizer-state "
+        "slots (Adam moments, Momentum velocity) are partitioned "
+        "across dp ranks, each parameter gradient is reduce-scattered "
+        "to its owning shard (parallel/sharding.py zero1_plan), the "
+        "update runs on the local shard, and the updated parameter is "
+        "all-gathered back replicated. Parameters whose dims the "
+        "data-axis product does not divide (scalars, beta-pow "
+        "accumulators) keep the replicated all-reduce path. Keyed into "
+        "the executable cache; the static analyzer predicts the new "
+        "schedule with analyze_spmd(zero1=True). No-op without a "
+        "mesh, under gradient accumulation, and under remat."),
+    "grad_bucket_mb": (
+        float, 0.0,
+        "Bucketed gradient reduction under the ZeRO-1 sharded update "
+        "(PADDLE_TPU_ZERO): gradients are grouped in backward "
+        "production order into buckets of roughly this many MB and "
+        "each full bucket is fenced with jax.lax.optimization_barrier, "
+        "so XLA schedules earlier buckets' reduce-scatters while the "
+        "remaining backward still computes instead of paying one "
+        "end-of-step reduction barrier. Collective counts and payloads "
+        "are unchanged — only scheduling freedom moves — so "
+        "spmd.prediction_delta stays exact at every bucket size. "
+        "<=0 = one unbucketed schedule (XLA's default placement)."),
     "hbm_budget_frac": (
         float, 0.9,
         "Fraction of device memory (observability.memory."

@@ -16,6 +16,7 @@ __all__ = [
     "conv2d_transpose",
     "pool2d",
     "batch_norm",
+    "sync_batch_norm",
     "layer_norm",
     "group_norm",
     "dropout",
@@ -384,7 +385,39 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                fuse_with_relu=False, use_global_stats=False):
     """Batch normalization (reference: layers/nn.py:2753) with persistable
     moving mean/variance updated in-program."""
-    helper = LayerHelper("batch_norm", name=name, act=act)
+    return _batch_norm_layer(
+        "batch_norm", input, act=act, is_test=is_test, momentum=momentum,
+        epsilon=epsilon, param_attr=param_attr, bias_attr=bias_attr,
+        data_layout=data_layout, name=name,
+        moving_mean_name=moving_mean_name,
+        moving_variance_name=moving_variance_name,
+        use_global_stats=use_global_stats)
+
+
+def sync_batch_norm(input, act=None, is_test=False, momentum=0.9,
+                    epsilon=1e-5, param_attr=None, bias_attr=None,
+                    data_layout="NCHW", name=None, moving_mean_name=None,
+                    moving_variance_name=None, use_global_stats=False):
+    """Cross-replica batch normalization (reference: sync_batch_norm_op):
+    batch statistics are computed over the GLOBAL batch — every data-
+    parallel shard contributes to the mean/variance via one psum each.
+    Under GSPMD that is batch_norm's semantics already (the partitioner
+    derives the collectives from the batch sharding), so this layer only
+    stamps the distinct op type for program-level tooling."""
+    return _batch_norm_layer(
+        "sync_batch_norm", input, act=act, is_test=is_test,
+        momentum=momentum, epsilon=epsilon, param_attr=param_attr,
+        bias_attr=bias_attr, data_layout=data_layout, name=name,
+        moving_mean_name=moving_mean_name,
+        moving_variance_name=moving_variance_name,
+        use_global_stats=use_global_stats)
+
+
+def _batch_norm_layer(op_type, input, act=None, is_test=False, momentum=0.9,
+                      epsilon=1e-5, param_attr=None, bias_attr=None,
+                      data_layout="NCHW", name=None, moving_mean_name=None,
+                      moving_variance_name=None, use_global_stats=False):
+    helper = LayerHelper(op_type, name=name, act=act)
     dtype = input.dtype
     if data_layout == "NCHW":
         channel_num = input.shape[1]
@@ -418,7 +451,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     out = helper.create_variable_for_type_inference(dtype)
 
     helper.append_op(
-        type="batch_norm",
+        type=op_type,
         inputs={
             "X": [input],
             "Scale": [scale],

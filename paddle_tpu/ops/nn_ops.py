@@ -322,6 +322,20 @@ def batch_norm_grad(ctx, ins, attrs):
             "Bias@GRAD": [dbias.astype(scale.dtype)]}
 
 
+# sync_batch_norm (reference: sync_batch_norm_op.cu, which all-reduces
+# the per-device sums) is batch_norm's natural GSPMD semantics: the
+# jnp.mean reductions above run over the batch-sharded activation, so
+# the partitioner inserts the cross-replica psums itself and the batch
+# statistics are already global. The distributed op is therefore a pure
+# alias of the local kernels.
+register_op(
+    "sync_batch_norm",
+    no_grad_inputs=("Mean", "Variance"),
+    grad_needs_outputs=("SavedMean", "SavedVariance"),
+)(batch_norm)
+register_no_grad_op("sync_batch_norm_grad")(batch_norm_grad)
+
+
 def _fused_attention_args(ctx, ins, attrs):
     """Shared forward/backward argument resolution — the grad op MUST see
     the same dtypes, mask, dropout seed (same per-op rng stream id), and

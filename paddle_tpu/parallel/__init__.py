@@ -22,7 +22,10 @@ from paddle_tpu.parallel.mesh import (  # noqa: F401
 from paddle_tpu.parallel.sharding import (  # noqa: F401
     Coverage,
     ShardingRules,
+    Zero1Plan,
     batch_sharding,
+    zero1_extend_spec,
+    zero1_plan,
 )
 from paddle_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

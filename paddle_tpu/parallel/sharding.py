@@ -123,6 +123,149 @@ class ShardingRules:
         return NamedSharding(mesh, self.spec_for(name, ndim))
 
 
+# Grad producers whose outputs must NOT take the extended (dp-sharded)
+# constraint: scatter-add embedding grads flip the partitioner into a
+# gather-scatter lowering XLA picks per-backend. Their params still
+# join the sharded update (slots partitioned, one all-gather of the
+# updated shard); only the gradient is pinned replicated, so its
+# all-reduce stays exactly the baseline one and the update slices the
+# full grad locally for free.
+ZERO1_REPLICATED_GRAD_OPS = frozenset({
+    "lookup_table_grad", "lookup_table_v2_grad",
+})
+
+# Param groups left OFF the sharded update entirely: partitioning a
+# batch-norm scale/bias update (even with the grad pinned replicated)
+# makes XLA materialize C-shards of the fused forward stat math and
+# re-gather them — ~7 discretionary tiny all-gathers per BN layer that
+# no static schedule predicts. BN slots are ~1% of optimizer state, so
+# keeping them replicated costs nothing measurable.
+ZERO1_EXCLUDED_GRAD_OPS = frozenset({
+    "batch_norm_grad", "sync_batch_norm_grad",
+})
+
+Zero1Plan = collections.namedtuple(
+    "Zero1Plan", ["param_specs", "slot_specs", "grad_specs"])
+Zero1Plan.__doc__ = """ZeRO-1 weight-update sharding plan over a block's
+Optimize-role ops: ``param_specs`` {param: extended PartitionSpec} — the
+shard each rank updates (the param itself stays replicated in scope;
+the replicated out_sharding is what makes XLA all-gather the update);
+``slot_specs`` {slot var: spec} — optimizer-state shardings the engine
+installs in in/out_shardings so moments/velocity live partitioned;
+``grad_specs`` {grad name: spec} — constraint points that turn each
+grad's all-reduce into a reduce-scatter to the owning shard."""
+
+
+def zero1_extend_spec(spec, shape, data_axes, mesh_axes):
+    """The ZeRO-1 placement rule, shared verbatim by the engine's
+    compile seam and the static analyzer (analysis/spmd.py) so the
+    predicted collective schedule matches the compiled one: extend a
+    var's PartitionSpec with the data axes on the FIRST dim that
+    carries no axes yet and whose size the data-axis product divides.
+    Returns the extended PartitionSpec, or None when no dim qualifies
+    (scalars, beta-pow accumulators, odd shapes — those vars keep the
+    replicated path) or the data axes are already in use."""
+    axes = [a for a in data_axes if int(mesh_axes.get(a, 1)) > 1]
+    if not axes or shape is None:
+        return None
+    n_data = 1
+    for a in axes:
+        n_data *= int(mesh_axes[a])
+    entries = list(tuple(spec))
+    while len(entries) < len(shape):
+        entries.append(None)
+    used = set()
+    for e in entries:
+        if e is None:
+            continue
+        if isinstance(e, (tuple, list)):
+            used.update(str(a) for a in e)
+        else:
+            used.add(str(e))
+    if any(a in used for a in axes):
+        return None
+    for i, d in enumerate(tuple(shape)):
+        if entries[i] is None and int(d) > 0 and int(d) % n_data == 0:
+            entries[i] = tuple(axes) if len(axes) > 1 else axes[0]
+            return PartitionSpec(*entries)
+    return None
+
+
+def _base_spec(shard_rules, name, ndim):
+    """The spec the engine's state_sharding would lay a var out with:
+    first-match rule, replicated on no match or rank mismatch."""
+    if shard_rules is None:
+        return PartitionSpec()
+    try:
+        spec = shard_rules.spec_for(name)
+    except ValueError:
+        return PartitionSpec()
+    if ndim is not None and len(tuple(spec)) > ndim:
+        return PartitionSpec()
+    return spec
+
+
+def zero1_plan(block, mesh_axes, data_axes=("dp",), shard_rules=None):
+    """Walk a block's Optimize-role ops (reference optimizer contract:
+    one update op per parameter with Param/Grad inputs and slot-state
+    side inputs) into a :class:`Zero1Plan`. ``mesh_axes`` is a
+    {axis: size} dict (jax Mesh ``.shape`` works). Param groups whose
+    gradient is a SelectedRows var (sparse embedding updates) or whose
+    param no data-axis dim divides are left on the replicated path."""
+    from paddle_tpu.framework import OpRole
+
+    mesh_axes = {str(k): int(v) for k, v in dict(mesh_axes).items()}
+    param_specs, slot_specs, grad_specs = {}, {}, {}
+    writer_types = {}
+    for op in block.ops:
+        for n in op.output_arg_names():
+            writer_types.setdefault(n, set()).add(op.type)
+    for op in block.ops:
+        if not (int(op.attrs.get("op_role", 0)) & OpRole.Optimize):
+            continue
+        pnames = op.inputs.get("Param") or ()
+        gnames = op.inputs.get("Grad") or ()
+        if not pnames or not gnames:
+            continue
+        pvd = block.find_var_recursive(pnames[0])
+        gvd = block.find_var_recursive(gnames[0])
+        if pvd is None or pvd.shape is None:
+            continue
+        from paddle_tpu.core.types import VarType
+
+        if (gvd is not None and gvd.type is not None
+                and int(gvd.type) == int(VarType.SELECTED_ROWS)):
+            continue  # sparse grads can't take a sharding constraint
+        if writer_types.get(gnames[0], set()) & ZERO1_EXCLUDED_GRAD_OPS:
+            continue  # batch-norm updates stay replicated (see above)
+        shape = tuple(pvd.shape)
+        zspec = zero1_extend_spec(
+            _base_spec(shard_rules, pnames[0], len(shape)), shape,
+            data_axes, mesh_axes)
+        if zspec is None:
+            continue
+        param_specs[pnames[0]] = zspec
+        grad_specs[gnames[0]] = (
+            PartitionSpec()
+            if writer_types.get(gnames[0], set()) & ZERO1_REPLICATED_GRAD_OPS
+            else zspec)
+        for slot, names in op.inputs.items():
+            if slot in ("Param", "Grad"):
+                continue
+            for n in names:
+                vd = block.find_var_recursive(n)
+                if (vd is None or not vd.persistable
+                        or getattr(vd, "is_parameter", False)
+                        or vd.shape is None or n in slot_specs):
+                    continue
+                sspec = zero1_extend_spec(
+                    _base_spec(shard_rules, n, len(vd.shape)),
+                    tuple(vd.shape), data_axes, mesh_axes)
+                if sspec is not None:
+                    slot_specs[n] = sspec
+    return Zero1Plan(param_specs, slot_specs, grad_specs)
+
+
 def batch_sharding(mesh, value, data_axes=("dp",)):
     """Shard the leading (batch) dim over the data axes if divisible,
     else replicate (ragged last batches fall back gracefully — the analog

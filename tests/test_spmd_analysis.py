@@ -353,6 +353,241 @@ def test_prediction_delta_telemetry_at_cache_miss_seam():
         flags.reset_flag("spmd_predict")
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded weight update: the exact-match bar extends to the
+# reduce-scatter/all-gather schedule, the post-sharding ledger, loss
+# parity against the replicated update, and bucketed overlap
+# ---------------------------------------------------------------------------
+
+def _compiled_schedule(main, startup, loss, feed, axes):
+    """Compile at the engine's cache-miss seam and return (plan, measured)
+    for the current flag state."""
+    mesh = make_mesh(axes)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        eng = exe.engine
+        feed_names, feed_values = eng._coerce_feed(main.desc.block(0),
+                                                   feed)
+        compiled = eng.get_compiled(
+            main.desc, 0, feed_names, feed_values, [loss.name], False,
+            True, False, 1, mesh=mesh, shard_rules=ShardingRules(),
+            opt_level=0, scope=scope)
+        plan = compiled.spmd_plan
+        assert plan is not None and not plan.empty
+        mutated = [eng._state_value(scope, n)
+                   for n in compiled.mutated_names]
+        readonly = [eng._state_value(scope, n)
+                    for n in compiled.readonly_names]
+        hlo = compiled.jitted.lower(
+            feed_values, mutated, readonly,
+            (np.uint32(0), np.uint32(1))).compile().as_text()
+    return plan, measured_collectives(hlo)
+
+
+@pytest.mark.parametrize("which,axes", [
+    ("bert", {"dp": 2}),
+    ("resnet", {"dp": 2}),
+    pytest.param("bert", {"dp": 2, "tp": 2}, marks=pytest.mark.slow),
+    pytest.param("resnet", {"dp": 2, "tp": 2}, marks=pytest.mark.slow),
+])
+def test_zero1_schedule_matches_compiled_hlo(which, axes):
+    """With the sharded update on, the analyzer must predict the whole
+    reduce-scatter/all-gather schedule — psum AND all-gather counts
+    EXACT against the compiled HLO (XLA's CPU lowering folds the
+    reduce-scatter into the all-reduce the parser already counts as a
+    psum; the per-param all-gather of the updated shard is the new,
+    separately-counted collective)."""
+    flags.set_flags({"zero": True})
+    try:
+        main, startup, loss, feed = _build_model(which)
+        plan, meas = _compiled_schedule(main, startup, loss, feed, axes)
+    finally:
+        flags.reset_flag("zero")
+    assert plan.zero1, "plan must record the sharded update was on"
+    assert plan.all_gather_count > 0
+    assert plan.psum_count == meas["psum_count"], (
+        which, axes, plan.render())
+    assert plan.all_gather_count == meas["all_gather_count"], (
+        which, axes, plan.render())
+    assert abs(plan.total_bytes - meas["total_bytes"]) \
+        <= 0.10 * meas["total_bytes"], (which, axes)
+    # the acceptance ledger: optimizer state is partitioned, only the
+    # scalar accumulators (and resnet's excluded BN slots) replicate
+    budget = 16 * 1024 if which == "resnet" else 1024
+    assert plan.opt_state.replicated_bytes <= budget, (
+        which, plan.opt_state.replicated_bytes)
+
+
+def test_zero1_bucketed_schedule_stays_exact():
+    """Bucketed reduction only fences WHEN grads fire — it must not add,
+    drop, or resize any collective, so the exact-match bar holds at any
+    bucket size and the schedule matches the unbucketed one."""
+    schedules = {}
+    for bucket in (0.0, 1.0):
+        flags.set_flags({"zero": True, "grad_bucket_mb": bucket})
+        try:
+            main, startup, h = models.mnist.get_model()
+            rng = np.random.RandomState(0)
+            feed = {"img": rng.randn(8, 784).astype(np.float32),
+                    "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+            plan, meas = _compiled_schedule(main, startup, h["loss"],
+                                            feed, {"dp": 2})
+        finally:
+            flags.reset_flag("zero")
+            flags.reset_flag("grad_bucket_mb")
+        assert plan.psum_count == meas["psum_count"], plan.render()
+        assert plan.all_gather_count == meas["all_gather_count"], \
+            plan.render()
+        schedules[bucket] = (meas["psum_count"],
+                            meas["all_gather_count"])
+    assert schedules[0.0] == schedules[1.0]
+
+
+def test_zero1_ledger_reads_post_sharding():
+    """analyze_spmd(zero1=True) reports the POST-sharding optimizer
+    ledger: the Adam moments are partitioned so replicated_bytes falls
+    to the scalar accumulators, and the render says which world the
+    numbers describe."""
+    main, startup, h = models.mnist.get_model()
+    rep = analyze_spmd(main.desc, mesh={"dp": 2},
+                       shard_rules=ShardingRules(),
+                       feed_shapes={"img": (8, 784), "label": (8, 1)},
+                       fetch_names=[h["loss"].name], zero1=True)
+    assert rep.zero1
+    base = analyze_spmd(main.desc, mesh={"dp": 2},
+                        shard_rules=ShardingRules(),
+                        feed_shapes={"img": (8, 784), "label": (8, 1)},
+                        fetch_names=[h["loss"].name])
+    assert not base.zero1
+    # moments move off the replicated ledger; only beta-pow scalars stay
+    assert rep.opt_state.replicated_bytes < \
+        base.opt_state.replicated_bytes // 100
+    assert "post-sharding" in rep.render()
+
+
+def test_zero1_loss_parity_with_replicated_update():
+    """The sharded update is an EXECUTION layout, not a different
+    optimizer: training under zero must track the replicated update to
+    numerical noise (empirically bit-exact on CPU)."""
+    frng = np.random.RandomState(7)
+    feed = {"img": frng.randn(8, 784).astype(np.float32),
+            "label": frng.randint(0, 10, (8, 1)).astype(np.int64)}
+    losses = {}
+    for zero in (False, True):
+        flags.set_flags({"zero": zero})
+        try:
+            main, startup, h = models.mnist.get_model()
+            exe = fluid.Executor()
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                out = []
+                for _ in range(3):
+                    r = exe.run(main, feed=feed, fetch_list=[h["loss"]],
+                                mesh=make_mesh({"dp": 2}),
+                                shard_rules=ShardingRules())
+                    out.append(float(np.asarray(r[0]).ravel()[0]))
+            losses[zero] = out
+        finally:
+            flags.reset_flag("zero")
+    assert np.allclose(losses[False], losses[True],
+                       rtol=1e-5, atol=1e-7), losses
+
+
+@pytest.mark.slow
+def test_zero1_loss_parity_resnet():
+    """Same parity bar on a book model with Momentum slots and BN
+    (whose param groups the plan deliberately leaves replicated)."""
+    frng = np.random.RandomState(11)
+    feed = {"img": frng.randn(8, 3, 32, 32).astype(np.float32),
+            "label": frng.randint(0, 10, (8, 1)).astype(np.int64)}
+    losses = {}
+    for zero in (False, True):
+        flags.set_flags({"zero": zero})
+        try:
+            main, startup, loss, _ = _build_model("resnet")
+            exe = fluid.Executor()
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                out = []
+                for _ in range(2):
+                    r = exe.run(main, feed=feed, fetch_list=[loss],
+                                mesh=make_mesh({"dp": 2}),
+                                shard_rules=ShardingRules())
+                    out.append(float(np.asarray(r[0]).ravel()[0]))
+            losses[zero] = out
+        finally:
+            flags.reset_flag("zero")
+    assert np.allclose(losses[False], losses[True],
+                       rtol=1e-5, atol=1e-7), losses
+
+
+# ---------------------------------------------------------------------------
+# sync_batch_norm: the distributed-BN op joins the rule table
+# ---------------------------------------------------------------------------
+
+def _bn_model(sync):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        layers = fluid.layers
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        conv = layers.conv2d(img, num_filters=4, filter_size=3,
+                             padding=1,
+                             param_attr=fluid.ParamAttr(name="zbw"))
+        bn = (layers.sync_batch_norm if sync else layers.batch_norm)(
+            conv, act="relu")
+        pool = layers.pool2d(bn, pool_size=8, pool_type="avg")
+        fc = layers.fc(pool, size=10,
+                       param_attr=fluid.ParamAttr(name="zfw"))
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(fc, label))
+        fluid.optimizer.Momentum(learning_rate=0.1,
+                                 momentum=0.9).minimize(loss)
+    rng = np.random.RandomState(3)
+    feed = {"img": rng.randn(8, 3, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+    return main, startup, loss, feed
+
+
+def test_sync_batch_norm_matches_batch_norm_losses():
+    """Under GSPMD, batch_norm already computes GLOBAL batch statistics
+    (the partitioner psums the jnp.mean over the batch-sharded x), so
+    the explicit sync op must be numerically identical to it."""
+    losses = {}
+    for sync in (False, True):
+        main, startup, loss, feed = _bn_model(sync)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out = []
+            for _ in range(3):
+                r = exe.run(main, feed=feed, fetch_list=[loss],
+                            mesh=make_mesh({"dp": 2}),
+                            shard_rules=ShardingRules())
+                out.append(float(np.asarray(r[0]).ravel()[0]))
+        losses[sync] = out
+    assert losses[False] == losses[True], losses
+
+
+def test_sync_batch_norm_schedule_predicted_exactly():
+    """The analyzer's batch_norm rule covers the sync alias: two stat
+    psums per training BN, schedule exact against the compiled HLO."""
+    main, startup, loss, feed = _bn_model(sync=True)
+    plan, meas = _compiled_schedule(main, startup, loss, feed, {"dp": 2})
+    assert plan.psum_count == meas["psum_count"], plan.render()
+    assert plan.all_gather_count == meas["all_gather_count"]
+    stat_psums = [c for c in plan.collectives
+                  if c.kind == "psum" and "batch_norm" in c.reason]
+    assert len(stat_psums) == 2  # mean + var over the dp axis
+    assert all(c.axes == ("dp",) for c in stat_psums)
+
+
 def test_no_seam_without_flag():
     flags.set_flags({"metrics": True})
     try:

@@ -27,7 +27,8 @@ import json
 import sys
 
 HIGHER = ("per_sec", "per_s", "speedup", "qps", "hit", "goodput",
-          "frac", "mfu", "fill", "efficiency", "max_batch")
+          "frac", "mfu", "fill", "efficiency", "max_batch",
+          "savings_bytes")
 LOWER = ("_ms", "_bytes", "_ns", "miss", "evict", "trips", "crashes",
          "wall", "dropped", "failed", "skew", "spread", "overhead",
          "badput", "retries", "transpose")

@@ -20,6 +20,10 @@ prove the whole failure-domain story at once:
     layout    rank loss mid-window with the    shrink + replay stays
               NHWC layout pass rewriting the   bit-exact with HWIO-baked
               conv probe (PADDLE_TPU_LAYOUT)   weights in the checkpoints
+    zero1     permanent rank loss with the     mesh shrink reshards the
+              ZeRO-1 sharded Momentum update   partitioned velocity
+              on the dp mesh (PADDLE_TPU_ZERO) slots; survivors keep
+                                               fault-free parity
 
 Usage::
 
@@ -66,6 +70,12 @@ GATES = [
     # loss mid dispatch window — the layout pass may not perturb
     # bit-exact replay under any recovery path
     ("layout", ["--layout", "--shrink", "--dispatch-steps", "4"]),
+    # the ZeRO-1 sharded weight update on the dp mesh: the permanent
+    # rank loss shrinks the workers' mesh while the Momentum velocity
+    # slots live dp-sharded — the reshard-on-shrink seam must migrate
+    # the partitioned optimizer state and keep fault-free parity
+    # (tests/test_elastic.py has the in-process half of this coverage)
+    ("zero1", ["--shrink", "--mesh", "--zero1"]),
 ]
 
 

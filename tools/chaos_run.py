@@ -38,6 +38,11 @@ Usage::
     python tools/chaos_run.py --preempt --nproc 2     # graceful SIGTERM:
         # rank 0 drains + checkpoints + exits rc 46; the supervisor
         # restarts WITHOUT spending restart budget and the job completes
+    python tools/chaos_run.py --shrink --mesh --zero1 --nproc 2
+        # ZeRO-1 sharded update on the dp mesh: the Momentum velocity
+        # slots live partitioned, the mid-run rank loss shrinks the
+        # mesh (sharded state reshards onto the survivors), and the
+        # trajectory must keep fault-free parity
 
 CPU-only by construction (workers force JAX_PLATFORMS=cpu); the point
 is recovery-path coverage, not throughput.
@@ -63,6 +68,16 @@ def _layout_mode():
     check."""
     return os.environ.get("PADDLE_TPU_LAYOUT", "").strip().lower() \
         == "nhwc"
+
+
+def _zero1_mode():
+    """--zero1 gate: reads the engine's own PADDLE_TPU_ZERO flag env so
+    the probe model switches to Momentum (slot state for the sharded
+    update to partition) identically in workers AND the supervisor's
+    in-process parity reference — where the flag itself is inert
+    because the reference runs mesh-less."""
+    return os.environ.get("PADDLE_TPU_ZERO", "").strip().lower() \
+        not in ("", "0", "false")
 
 
 def build(lr=0.1):
@@ -97,7 +112,11 @@ def build(lr=0.1):
                                bias_attr=False)
         loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
             logits=pred, label=y))
-        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        if _zero1_mode():
+            fluid.optimizer.Momentum(learning_rate=lr,
+                                     momentum=0.9).minimize(loss)
+        else:
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
     init = {
         "cw2": np.linspace(0.3, -0.3, 16 * 4).astype(
             np.float32).reshape(16, 4),
@@ -353,6 +372,8 @@ def run_supervisor(args):
         env_extra["PADDLE_TPU_SDC"] = "1"
     if args.layout:
         env_extra["PADDLE_TPU_LAYOUT"] = "nhwc"
+    if args.zero1:
+        env_extra["PADDLE_TPU_ZERO"] = "1"
     if args.ckpt_replicas:
         env_extra["PADDLE_TPU_CKPT_REPLICAS"] = str(args.ckpt_replicas)
     worker_cmd = [os.path.abspath(__file__), "--worker",
@@ -624,6 +645,15 @@ def main():
                              "baked HWIO into the checkpointed scope, "
                              "and restart/rollback must still replay to "
                              "bit-exact fault-free parity")
+    parser.add_argument("--zero1", action="store_true",
+                        help="run everything with PADDLE_TPU_ZERO=1 and "
+                             "a Momentum probe optimizer: the workers' "
+                             "dp-mesh update is ZeRO-1 sharded (velocity "
+                             "slots partitioned, params all-gathered "
+                             "after the shard update) and every "
+                             "recovery path — restart, shrink, replay — "
+                             "must keep fault-free parity with the "
+                             "sharded state migrating across meshes")
     parser.add_argument("--check-parity", action="store_true",
                         default=True)
     parser.add_argument("--no-check-parity", dest="check_parity",
@@ -634,6 +664,10 @@ def main():
         # in-process parity reference builds the same conv probe and
         # runs the same NHWC-rewritten executable as the workers
         os.environ["PADDLE_TPU_LAYOUT"] = "nhwc"
+    if args.zero1:
+        # same reasoning: the parity reference must build the Momentum
+        # probe; the zero flag itself is inert there (no mesh)
+        os.environ["PADDLE_TPU_ZERO"] = "1"
     if args.worker:
         return run_worker(args)
     os.environ.setdefault("XLA_FLAGS",

@@ -30,11 +30,15 @@ would be re-laid-out OIHW->HWIO. ``--spmd`` additionally prints each
 program's static SPMD report (analysis/spmd.py) under the --mesh/--rule
 table: sharding table, predicted collective schedule with bytes,
 per-device peak vs replicated peak, and the replicated-optimizer-state
-(ZeRO-1) ledger. ``--flags`` cross-references the README flags table
+(ZeRO-1) ledger; add ``--zero1`` to analyze with the sharded weight
+update ON — the schedule gains the per-param all-gathers and the
+ledger reads post-sharding (near zero when the plan covers the
+optimizer state). ``--flags`` cross-references the README flags table
 against the flags.py DEFS registry and exits 1 on missing/stale rows.
 Exit code 1 iff any ERROR finding.
 
   python tools/lint_program.py --model mnist_mlp --spmd --mesh dp=2
+  python tools/lint_program.py --model mnist_mlp --spmd --zero1
   python tools/lint_program.py --flags
 
   python tools/lint_program.py
@@ -212,7 +216,7 @@ def _print_spmd_report(program_or_desc, args, feed_names=None,
     report = analyze_spmd(desc, mesh=mesh, shard_rules=rules,
                           feed_names=feed_names,
                           feed_shapes=feed_shapes,
-                          fetch_names=fetch_names)
+                          fetch_names=fetch_names, zero1=args.zero1)
     print("-- spmd report --")
     print(report.render())
 
@@ -417,6 +421,12 @@ def main(argv=None):
     parser.add_argument("--batch", type=int, default=8, metavar="N",
                         help="batch size used to resolve dynamic feed "
                              "dims for --spmd (default 8)")
+    parser.add_argument("--zero1", action="store_true",
+                        help="analyze --spmd with the ZeRO-1 sharded "
+                             "weight update on (PADDLE_TPU_ZERO "
+                             "semantics): the schedule gains the per-"
+                             "param all-gathers and the optimizer-state "
+                             "ledger reads post-sharding")
     parser.add_argument("--flags", action="store_true",
                         help="cross-reference the README flags table "
                              "against the flags.py DEFS registry and "

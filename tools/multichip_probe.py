@@ -34,10 +34,16 @@ to the scaling table. ``--predict-tolerance F`` makes it a CI gate:
 exit non-zero when any device count's psum count mismatches or its
 collective bytes miss by more than the relative tolerance.
 
+``--zero1`` flips every child onto the ZeRO-1 sharded weight update
+(PADDLE_TPU_ZERO=1; optionally ``--bucket-mb N`` for bucketed gradient
+reduction) so two invocations give the replicated-vs-sharded scaling
+A/B that bench.py's multichip section automates.
+
 Usage:
   python tools/multichip_probe.py --model mlp --devices 1,2,4,8
   python tools/multichip_probe.py --model bert --efficiency-floor 0.6
   python tools/multichip_probe.py --predict --predict-tolerance 0.1
+  python tools/multichip_probe.py --model mlp --zero1 --bucket-mb 4
 Bench integration: ``PADDLE_TPU_BENCH=multichip python bench.py`` calls
 ``probe_scaling()`` when fewer than 2 real devices exist.
 """
@@ -173,16 +179,22 @@ def _read_sink_span(path, name):
 
 
 def probe_scaling(model="mlp", devices=(1, 2, 4, 8), batch_per_device=64,
-                  steps=12, warmup=3, sink_dir=None, predict=False):
+                  steps=12, warmup=3, sink_dir=None, predict=False,
+                  zero1=False, bucket_mb=0.0):
     """Run the sweep; returns {n: samples_per_sec} (plus
-    {n: prediction_delta args} when ``predict``). Parent-side only."""
+    {n: prediction_delta args} when ``predict``). Parent-side only.
+    ``zero1``/``bucket_mb`` turn on the ZeRO-1 sharded weight update
+    (PADDLE_TPU_ZERO) and bucketed gradient reduction
+    (PADDLE_TPU_GRAD_BUCKET_MB) in every child — the A/B lever bench.py
+    sweeps to price the sharded update against the replicated one."""
     results = {}
     predictions = {}
     own_tmp = sink_dir is None
     if own_tmp:
         sink_dir = tempfile.mkdtemp(prefix="multichip_probe_")
     for n in devices:
-        sink = os.path.join(sink_dir, "probe_dp%d.jsonl" % n)
+        sink = os.path.join(sink_dir, "probe_dp%d%s.jsonl"
+                            % (n, "_zero1" if zero1 else ""))
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
@@ -190,6 +202,10 @@ def probe_scaling(model="mlp", devices=(1, 2, 4, 8), batch_per_device=64,
                             % n).strip()
         env["PADDLE_TPU_METRICS"] = "1"
         env["PADDLE_TPU_METRICS_SINK"] = sink
+        if zero1:
+            env["PADDLE_TPU_ZERO"] = "1"
+            if bucket_mb:
+                env["PADDLE_TPU_GRAD_BUCKET_MB"] = str(bucket_mb)
         if predict:
             env["PADDLE_TPU_SPMD_PREDICT"] = "1"
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -255,6 +271,14 @@ def main(argv=None):
     ap.add_argument("--sink-dir", default=None,
                     help="directory for the per-run telemetry sinks "
                          "(default: a fresh temp dir)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="train with the ZeRO-1 sharded weight update "
+                         "(PADDLE_TPU_ZERO=1 in every child) — combine "
+                         "with a plain run for the replicated-vs-"
+                         "sharded A/B")
+    ap.add_argument("--bucket-mb", type=float, default=0.0, metavar="MB",
+                    help="with --zero1: bucketed gradient reduction "
+                         "size (PADDLE_TPU_GRAD_BUCKET_MB)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
@@ -268,17 +292,24 @@ def main(argv=None):
     if predict:
         results, predictions = probe_scaling(
             args.model, devices, args.batch_per_device, args.steps,
-            args.warmup, args.sink_dir, predict=True)
+            args.warmup, args.sink_dir, predict=True,
+            zero1=args.zero1, bucket_mb=args.bucket_mb)
     else:
         results = probe_scaling(args.model, devices,
                                 args.batch_per_device, args.steps,
-                                args.warmup, args.sink_dir)
+                                args.warmup, args.sink_dir,
+                                zero1=args.zero1,
+                                bucket_mb=args.bucket_mb)
     rows = efficiency_table(results)
+    mode = ("zero1 bucket=%gMB" % args.bucket_mb if args.zero1
+            and args.bucket_mb else
+            "zero1" if args.zero1 else "replicated")
+    print("update: %s" % mode)
     print("%-8s %-18s %s" % ("devices", "samples/sec", "efficiency"))
     for n, t, eff in rows:
         print("%-8d %-18.2f %s" % (n, t,
                                    "%.3f" % eff if eff is not None else "-"))
-    summary = {"model": args.model,
+    summary = {"model": args.model, "update": mode,
                "throughput": {str(n): round(t, 2) for n, t, _ in rows},
                "efficiency": {str(n): round(eff, 4)
                               for n, _, eff in rows if eff is not None}}
