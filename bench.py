@@ -1508,6 +1508,69 @@ def bench_goodput():
     return out
 
 
+def bench_opprof():
+    """Op-attributed device time for a short profiled probe
+    (observability/opprof.py): a tiny fc training model runs three
+    steps under jax.profiler, stop_profiler joins the xplane device
+    events back to framework-op provenance tags, and the resulting
+    opprof.* gauges ride here — per-op device ms (lower-better in
+    bench_diff), the unattributed remainder, and the attributed
+    fraction. On the CPU probe the events come from host XLA threads
+    ("cpu-coarse" source) so the absolute ms are trend-only; the
+    attribution JOIN is what this canaries — a clean probe must stay
+    >= 0.95 attributed.
+    """
+    import shutil
+    import tempfile
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags as _flags
+    from paddle_tpu import observability as _obs
+    from paddle_tpu import profiler as _prof
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.observability import opprof as _opprof
+
+    trace_dir = tempfile.mkdtemp(prefix="bench_opprof_")
+    _flags.set_flags({"trace_dir": trace_dir})
+    _opprof.reset()
+    try:
+        main_p, startup = Program(), Program()
+        with program_guard(main_p, startup):
+            x = fluid.layers.data(name="px", shape=[128], dtype="float32")
+            h = fluid.layers.fc(input=x, size=128, act="relu")
+            loss = fluid.layers.mean(fluid.layers.fc(input=h, size=10))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        feed = {"px": np.random.RandomState(11).randn(
+            64, 128).astype(np.float32)}
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        # warmup outside the trace: the compile wall would otherwise
+        # dwarf the 3 profiled steps and skew every per-op share
+        exe.run(main_p, feed=feed, fetch_list=[loss], scope=scope)
+        _prof.start_profiler()
+        for _ in range(3):
+            exe.run(main_p, feed=feed, fetch_list=[loss], scope=scope)
+        _prof.stop_profiler(
+            profile_path=os.path.join(trace_dir, "profile"))
+        gauges = _obs.snapshot()["gauges"]
+        out = {}
+        for key in ("attributed_frac", "unattributed_ms", "comm_ms"):
+            v = gauges.get("opprof." + key)
+            if v is not None:
+                out[key] = round(v, 4)
+        hot = sorted(
+            ((k[len("opprof."):], v) for k, v in gauges.items()
+             if k.startswith("opprof.pt.") and k.endswith("_ms")),
+            key=lambda kv: -kv[1])
+        for tag, v in hot[:8]:
+            out[tag] = round(v, 3)
+    finally:
+        _flags.reset_flag("trace_dir")
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    return out
+
+
 def main():
     from paddle_tpu import flags, observability
 
@@ -1749,6 +1812,15 @@ def main():
         result["counters"]["goodput"] = bench_goodput()
     except Exception as e:  # noqa: BLE001
         errors["goodput"] = str(e)[:200]
+    try:
+        # op-attributed device time: a 3-step profiled probe whose
+        # xplane events join back to framework-op provenance tags —
+        # per-op ms + attributed_frac trend across rounds, and a
+        # dropped join (attribution regression) shows as the frac
+        # collapsing, not as silent table rot
+        result["counters"]["opprof"] = bench_opprof()
+    except Exception as e:  # noqa: BLE001
+        errors["opprof"] = str(e)[:200]
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

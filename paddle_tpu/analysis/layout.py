@@ -509,6 +509,9 @@ def _rewrite(desc, plan, meta, mutate):
         if mutate:
             if base in _LAYOUT_ATTR:
                 op.attrs[_LAYOUT_ATTR[base]] = "NHWC"
+                # opprof provenance: mark the rewrite so the attribution
+                # table shows this op was layout-transformed from NCHW
+                op.attrs.setdefault("__src_ops__", [base + "@nchw"])
                 n_attr += 1
             elif (base.startswith("elementwise")
                   or base == "fused_elemwise_activation"):

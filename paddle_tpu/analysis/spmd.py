@@ -69,7 +69,7 @@ from paddle_tpu.analysis.memory import (
 
 __all__ = [
     "Collective", "SpmdReport", "analyze_spmd", "hlo_collectives",
-    "measured_collectives",
+    "measured_collectives", "op_flops_bytes",
 ]
 
 # Optimize-role bit (framework.OpRole mirror; see analysis/memory.py).
@@ -1233,6 +1233,102 @@ def measured_collectives(text):
         "total_bytes": sum(r["bytes"] for r in by_kind.values()),
         "by_kind": by_kind,
     }
+
+
+def _op_var_shape(block, name, feed_shapes, default_dim):
+    """Concrete shape of ``name`` from its VarDesc with -1 dims resolved
+    from the feed hints (or ``default_dim``), or None when undeclared /
+    shapeless."""
+    if block is None or not name:
+        return None
+    vd = block.find_var_recursive(name)
+    if vd is None or getattr(vd, "shape", None) is None:
+        return None
+    hint = (feed_shapes or {}).get(name)
+    shape = []
+    for i, d in enumerate(vd.shape):
+        d = int(d) if d is not None else -1
+        if d < 0:
+            d = (int(hint[i]) if hint is not None and i < len(hint)
+                 else default_dim)
+        shape.append(max(d, 0))
+    return shape
+
+
+def _prod(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def op_flops_bytes(op, block, feed_shapes=None, default_dim=None):
+    """Static per-op cost estimate ``(flops, bytes)`` for the op-level
+    roofline (observability/opprof.py) — the per-op analog of the
+    aggregate ``cost_analysis()`` MFU feed. Bytes are the op's tensor
+    traffic (every declared input + output var, from the same VarDesc
+    walk the liveness planner uses); FLOPs follow per-family rules:
+    matmul/conv count multiply-accumulates (x2), normalizations and
+    softmax count a small per-element constant, everything else one
+    flop per output element. ``*_grad`` ops cost ~2x their forward
+    (recompute + two matmul-shaped products is the dominant pattern).
+    Estimates, not measurements — good to the factor the roofline
+    verdict needs, not cycle-exact."""
+    import types as _types
+
+    feed_shapes = dict(feed_shapes or {})
+    if default_dim is None:
+        default_dim = max(
+            [int(s[0]) for s in feed_shapes.values() if len(s)] or [1])
+
+    is_grad = op.type.endswith("_grad")
+    base = op.type[:-len("_grad")] if is_grad else op.type
+
+    def shape_of(name):
+        return _op_var_shape(block, name, feed_shapes, default_dim)
+
+    def first_in(slot):
+        names = op.input(slot) if hasattr(op, "input") \
+            else op.inputs.get(slot, [])
+        return names[0] if names else None
+
+    nbytes = 0
+    for name in list(op.input_arg_names()) + list(op.output_arg_names()):
+        if not name or name.startswith("@"):
+            continue
+        vd = block.find_var_recursive(name) if block is not None else None
+        if vd is None:
+            continue
+        nbytes += _var_nbytes(
+            _types.SimpleNamespace(name=name, desc=vd),
+            feed_shapes, default_dim=default_dim)
+
+    out_elems = 0
+    for name in op.output_arg_names():
+        s = shape_of(name)
+        if s:
+            out_elems = max(out_elems, _prod(s))
+
+    flops = out_elems  # default: one flop per output element
+    if base in ("mul", "matmul", "matmul_v2"):
+        x = shape_of(first_in("X"))
+        k = x[-1] if x else 1
+        flops = 2 * out_elems * max(k, 1)
+    elif base in ("conv2d", "depthwise_conv2d", "conv2d_transpose"):
+        f = shape_of(first_in("Filter"))
+        per_out = _prod(f[1:]) if f and len(f) > 1 else 1
+        flops = 2 * out_elems * max(per_out, 1)
+    elif base == "fused_attention":
+        q = shape_of(first_in("Q")) or shape_of(first_in("X"))
+        seq = q[-2] if q and len(q) >= 2 else 1
+        flops = 4 * (_prod(q) if q else out_elems) * max(seq, 1)
+    elif base in ("softmax", "softmax_with_cross_entropy", "layer_norm",
+                  "batch_norm", "sync_batch_norm",
+                  "fused_elemwise_activation"):
+        flops = 8 * out_elems
+    if is_grad:
+        flops *= 2
+    return int(flops), int(nbytes)
 
 
 # -- registry checkers ------------------------------------------------------

@@ -609,6 +609,11 @@ class AttentionFusePass(TransformPass):
             "scale": m.scale,
             "dropout_rate": m.dropout_rate,
             "op_role": int(m.fwd_anchor.attrs.get("op_role", 0)),
+            # opprof provenance: the source-op list this fusion replaced,
+            # so the attribution table expands pt.fused_attention.* back
+            # to the pattern's ops (engine-internal __ attr, stripped
+            # before the lowering sees it)
+            "__src_ops__": [o.type for o in m.fwd_ops],
         }
         if m.is_test:
             attrs["is_test"] = True
@@ -627,6 +632,7 @@ class AttentionFusePass(TransformPass):
             gattrs["op_role"] = int(OpRole.Backward)
             gattrs["__fwd_inputs__"] = sorted(inputs)
             gattrs["__fwd_outputs__"] = ["Lse", "Out"]
+            gattrs["__src_ops__"] = [o.type for o in m.bwd_ops]
             ginputs = {s: list(ns) for s, ns in inputs.items()}
             ginputs["Out"] = [m.out]
             ginputs["Lse"] = [lse]
@@ -705,6 +711,8 @@ class ElemwiseActFusePass(TransformPass):
                 "functor_list": ["elementwise_add", act.type],
                 "axis": op.attrs.get("axis", -1),
                 "op_role": int(act.attrs.get("op_role", 0)),
+                # opprof provenance: fused ops keep their source-op list
+                "__src_ops__": ["elementwise_add", act.type],
             }
             # activation attrs ride along (e.g. gelu's `approximate`)
             for name, val in act.attrs.items():

@@ -452,6 +452,34 @@ class Engine:
             # watermark, and the edge-triggered memory_pressure event.
             obs.memory.record_step_memory(scope, step=self._run_counter)
 
+        if (obs.enabled()
+                and not getattr(compiled, "opprof_registered", True)):
+            # Op-provenance registration, once per executable (retried
+            # on the first observed step, so executables compiled before
+            # the profiler/metrics gate went up still register): parse
+            # the jitted HLO (lower() hits jax's caches — a retrace, not
+            # a second XLA compile) into the instruction -> provenance
+            # tag map and join the per-op FLOPs/bytes estimates, feeding
+            # the opprof registry that profiler.stop_profiler and
+            # perf_report --roofline attribute xplane device time with.
+            compiled.opprof_registered = True
+            try:
+                from paddle_tpu.observability import opprof as _opprof
+
+                hlo = compiled.jitted.lower(
+                    feed_values, mutated, readonly,
+                    rng_seed).compile().as_text()
+                _opprof.register_executable(
+                    hlo, compiled.provenance,
+                    block=compiled.block_program.block,
+                    feed_shapes={
+                        n: tuple(v.shape) for n, v in zip(
+                            compiled.block_program.feed_names,
+                            feed_values)})
+                obs.inc("opprof.executables")
+            except Exception:
+                obs.inc("opprof.register_crashes")
+
         defer = dispatch_steps > 1
         probes = []
         if self.check_nan_inf:
@@ -622,6 +650,7 @@ class Engine:
             mem_budget,
             sdc,
             layout_key,
+            bool(flags.get_flag("opprof")),
         )
         compiled = self._cache.get(key)
         if compiled is None:
@@ -946,18 +975,26 @@ class Engine:
                           params=len(zplan.param_specs),
                           slots=len(zplan.slot_specs),
                           bucket_mb=float(grad_bucket_mb))
+        # opprof provenance collection: a dict the lowering fills at jit
+        # trace time (tag -> OpDesc) — lazily, on the wrapped fn's first
+        # trace, so the recorded tags always match exactly what was
+        # emitted (including the accumulated lowering's once-op index
+        # offset). None = the named-scope wrap is skipped entirely.
+        from paddle_tpu import flags as _flags
+
+        prov = {} if _flags.get_flag("opprof") else None
         if accumulate_steps > 1:
             from paddle_tpu.engine.lowering import lower_block_accumulated
 
             fn = lower_block_accumulated(
                 bp, accumulate_steps, is_test=is_test, executor=self,
-                amp=amp)
+                amp=amp, prov=prov)
         elif remat_segments:
             from paddle_tpu.engine.lowering import lower_block_remat
 
             fn = lower_block_remat(
                 bp, remat_segments, is_test=is_test, executor=self,
-                amp=amp)
+                amp=amp, prov=prov)
         else:
             grad_sh = None
             if zplan is not None:
@@ -968,7 +1005,8 @@ class Engine:
             fn = lower_block(
                 bp, is_test=is_test, executor=self, amp=amp,
                 grad_shardings=grad_sh,
-                grad_bucket_bytes=int(float(grad_bucket_mb) * 2 ** 20))
+                grad_bucket_bytes=int(float(grad_bucket_mb) * 2 ** 20),
+                prov=prov)
 
         out_set = set(bp.state_out_names)
         mutated = [n for n in bp.state_in_names if n in out_set]
@@ -1115,6 +1153,8 @@ class Engine:
         cb = CompiledBlock(bp, jitted, mutated, readonly,
                            in_shardings=in_sh, memory_plan=memory_plan,
                            remat_segments=remat_segments)
+        cb.provenance = prov
+        cb.opprof_registered = prov is None
         if sdc:
             from paddle_tpu.resilience.sentinel import EWMABand
 

@@ -15,6 +15,8 @@ grad kernels (the bulk of the reference's operators/ directory) are replaced
 by autodiff of the lowering itself.
 """
 
+import contextlib
+
 import numpy as np
 
 import jax
@@ -22,6 +24,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.registry import OpRegistry, LowerContext
 from paddle_tpu.core.types import convert_dtype_to_np
+from paddle_tpu.observability import opprof as _opprof
 
 # Ops that are pure host-side markers and skipped during tracing.
 _SKIP_OPS = frozenset({"feed", "fetch"})
@@ -147,7 +150,7 @@ def _op_needs_rng(op):
 
 
 def lower_block(block_program, is_test=False, executor=None, amp=False,
-                grad_shardings=None, grad_bucket_bytes=0):
+                grad_shardings=None, grad_bucket_bytes=0, prov=None):
     """Returns fn(feeds: list, state_in: list, rng_key) ->
     (fetches: list, state_out: list).
 
@@ -218,7 +221,8 @@ def lower_block(block_program, is_test=False, executor=None, amp=False,
 
         with amp_scope(amp):
             for op_index, op in enumerate(block_program.ops):
-                run_op(op, block, env, rng_key, op_index, is_test, executor)
+                run_op(op, block, env, rng_key, op_index, is_test, executor,
+                       prov=prov)
                 if grad_shardings:
                     _constrain_grads(op)
             _flush_bucket()
@@ -241,8 +245,16 @@ def lower_block(block_program, is_test=False, executor=None, amp=False,
 EMPTY_VAR_NAME = "@EMPTY@"
 
 
-def run_op(op, block, env, rng_key, op_index, is_test, executor=None):
-    """Execute one op desc symbolically into env."""
+def run_op(op, block, env, rng_key, op_index, is_test, executor=None,
+           prov=None):
+    """Execute one op desc symbolically into env.
+
+    With ``prov`` (a dict, opprof provenance collection) the lowering
+    runs inside ``jax.named_scope(pt.<type>.<block>_<idx>)`` so XLA
+    op_metadata carries the framework-op identity through fusion, and
+    the tag -> OpDesc binding is recorded for the attribution join.
+    named_scope is metadata-only: the emitted computation is
+    bit-identical either way (tests/test_opprof.py asserts it)."""
     ins = {}
     for slot, names in op.inputs.items():
         vals = []
@@ -258,15 +270,23 @@ def run_op(op, block, env, rng_key, op_index, is_test, executor=None):
                     "holder)" % (op.type, slot, len(vals), n)
                 )
         ins[slot] = vals
-    if op.type.endswith("_grad") and not OpRegistry.has(op.type):
-        outs = _lower_grad_op(op, block, ins, rng_key, is_test)
+    if prov is not None:
+        tag = _opprof.provenance_tag(
+            op.type, getattr(block, "idx", 0), op_index)
+        prov[tag] = op
+        scope = jax.named_scope(tag)
     else:
-        info = OpRegistry.get(op.type)
-        ctx = LowerContext(
-            op, block, rng_key=rng_key, op_index=_rng_id(op, op_index),
-            is_test=is_test, executor=executor,
-        )
-        outs = info.lower(ctx, ins, clean_attrs(op.attrs))
+        scope = contextlib.nullcontext()
+    with scope:
+        if op.type.endswith("_grad") and not OpRegistry.has(op.type):
+            outs = _lower_grad_op(op, block, ins, rng_key, is_test)
+        else:
+            info = OpRegistry.get(op.type)
+            ctx = LowerContext(
+                op, block, rng_key=rng_key, op_index=_rng_id(op, op_index),
+                is_test=is_test, executor=executor,
+            )
+            outs = info.lower(ctx, ins, clean_attrs(op.attrs))
 
     _bind_outputs(op, outs, env)
 
@@ -344,7 +364,7 @@ def _lower_grad_op(op, block, ins, rng_key, is_test):
 
 
 def lower_block_remat(block_program, n_segments, is_test=False,
-                      executor=None, amp=False):
+                      executor=None, amp=False, prov=None):
     """Rematerialized training-step lowering: the forward segment runs as
     a chain of ``jax.checkpoint`` blocks and the parameter gradients come
     from ``jax.value_and_grad`` of that chain instead of the program's
@@ -516,7 +536,8 @@ def lower_block_remat(block_program, n_segments, is_test=False,
                 env.update(zip(in_names, in_vals))
                 with amp_scope(amp):
                     for j, op in seg:
-                        run_op(op, block, env, key, j, is_test, executor)
+                        run_op(op, block, env, key, j, is_test, executor,
+                               prov=prov)
                         _sg_op_outputs(op, env)
                 return tuple(env[n] for n in out_names)
             return run_seg
@@ -550,7 +571,8 @@ def lower_block_remat(block_program, n_segments, is_test=False,
 
         with amp_scope(amp):
             for j, op in tail_ops:
-                run_op(op, block, env, rng_key, j, is_test, executor)
+                run_op(op, block, env, rng_key, j, is_test, executor,
+                       prov=prov)
 
         fetches = [densify(env[n]) for n in block_program.fetch_names]
         state_out = [densify(env[n])
@@ -568,7 +590,7 @@ def np_value_for_var(var_desc, value):
 
 
 def lower_block_accumulated(block_program, k, is_test=False, executor=None,
-                            amp=False):
+                            amp=False, prov=None):
     """Gradient-accumulation lowering: the forward/backward segment runs as
     a ``lax.scan`` over ``k`` micro-batches (feeds reshaped [k, B/k, ...]),
     gradients crossing into the optimizer segment are averaged, and the
@@ -657,7 +679,8 @@ def lower_block_accumulated(block_program, k, is_test=False, executor=None,
             key = jax.random.fold_in(rng_key, t)
             with amp_scope(amp):
                 for i, op in enumerate(scan_ops):
-                    run_op(op, block, env, key, i, is_test, executor)
+                    run_op(op, block, env, key, i, is_test, executor,
+                           prov=prov)
             new_carry = tuple(env[n] for n in carry_names)
             outs = (tuple(env[n] for n in cross_names),
                     tuple(env[n] for n in last_names),
@@ -677,7 +700,7 @@ def lower_block_accumulated(block_program, k, is_test=False, executor=None,
         with amp_scope(amp):
             for i, op in enumerate(once_ops):
                 run_op(op, block, env, rng_key, 100_000 + i, is_test,
-                       executor)
+                       executor, prov=prov)
 
         micro_b = micro_feeds[0].shape[1] if micro_feeds else None
         fetch_map = dict(zip(fetch_scan, fetch_st))

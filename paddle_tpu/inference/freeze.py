@@ -191,12 +191,19 @@ class FoldBatchNormPass(TransformPass):
         scope.set(wf_name, w_f.astype(np.float32))
         scope.set(b_name, beta)
         producer.inputs[w_slot] = [wf_name]
+        # opprof provenance: the producer now carries the folded BN's
+        # scale, and the replacement bias add IS the folded BN — both
+        # record it in their source-op list for the attribution table
+        producer.attrs["__src_ops__"] = list(
+            producer.attrs.get("__src_ops__") or [producer.type]
+        ) + ["batch_norm"]
         role = int(op.attrs.get(OP_ROLE_KEY, 0) or 0)
         block.ops[op_idx] = OpDesc(
             "elementwise_add",
             inputs={"X": [x], "Y": [b_name]},
             outputs={"Out": [y]},
-            attrs={"axis": 1, OP_ROLE_KEY: role},
+            attrs={"axis": 1, OP_ROLE_KEY: role,
+                   "__src_ops__": ["batch_norm"]},
         )
         return True
 

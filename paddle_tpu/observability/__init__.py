@@ -33,6 +33,7 @@ from paddle_tpu.observability import (  # noqa: F401
     goodput,
     health,
     memory,
+    opprof,
 )
 from paddle_tpu.observability.export import (  # noqa: F401
     FlightRecorder,
@@ -56,7 +57,7 @@ __all__ = [
     "FlightRecorder", "JsonlSink", "MetricsRegistry", "SpanTracer",
     "attach_sink", "counter_value", "detach_sink", "dump_chrome_trace",
     "enabled", "event", "flush_sink", "goodput", "inc", "observe",
-    "registry",
+    "opprof", "registry",
     "health", "reset", "set_enabled", "set_gauge", "sink", "snapshot",
     "snapshot_text", "span", "spans", "time_block", "tracer",
 ]

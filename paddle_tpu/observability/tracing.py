@@ -232,7 +232,7 @@ def xplane_to_chrome_trace(trace_dir, line_filter=None):
     only. Folded in from tools/timeline.py so the package owns ONE
     trace-export entry point (``dump_chrome_trace(path, xplane_dir)``);
     the tools CLI is now a thin shim over this."""
-    from tools.xplane_top_ops import iter_planes
+    from paddle_tpu.observability.opprof import iter_planes
 
     events = []
     for pid, plane in enumerate(iter_planes(trace_dir), start=1):

@@ -78,21 +78,75 @@ def summary_table(sorted_key=None):
     return "\n".join(lines)
 
 
+def op_summary_text(table, top_k=15):
+    """The op-attributed device-time table as text: one row per
+    provenance tag (framework op), hottest first, with the roofline
+    verdict and the source-op list fused ops expand back to — the
+    replacement for staring at raw HLO fusion names."""
+    from paddle_tpu.observability import opprof
+
+    lines = [
+        "Device time by framework op (source: %s, fusion policy: %s)"
+        % (table["source"], table["fusion_policy"]),
+        "%-36s %10s %6s %10s %-13s %s"
+        % ("op", "ms", "%", "FLOP/B", "verdict", "src_ops")]
+    for tag, row in opprof.top_rows(table, top_k):
+        if row["ms"] <= 0:
+            continue
+        lines.append(
+            "%-36s %10.3f %5.1f%% %10.2f %-13s %s"
+            % (tag[:36], row["ms"], 100.0 * row["frac"],
+               row["intensity"], row["verdict"],
+               ",".join(row["src_ops"])[:40]))
+    lines.append(
+        "attributed %.1f%% of %.3f ms device time "
+        "(unattributed %.3f ms, comm lane %.3f ms)"
+        % (100.0 * table["attributed_frac"], table["total_ms"],
+           table["unattributed_ms"], table["comm_ms"]))
+    return "\n".join(lines)
+
+
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    """Stop both halves; write the host summary table to
-    ``profile_path`` honoring ``sorted_key`` (reference profiler.py:165
-    contract — the arguments are no longer ignored), the host spans as
-    chrome-trace JSON to ``<profile_path>.trace.json``, and the metrics
-    registry as Prometheus text exposition to
-    ``<profile_path>.metrics.prom`` (the ``snapshot_text`` dump a
-    scrape-less run still wants on disk). An attached streaming sink is
-    flushed so its JSONL tail is complete at the moment the session
-    ends."""
+    """Stop both halves; write the host summary table PLUS the
+    op-attributed device-time table (xplane time joined back to
+    ProgramDesc ops via the opprof provenance tags, with roofline
+    verdicts — no more raw HLO fusion names) to ``profile_path``
+    honoring ``sorted_key`` (reference profiler.py:165 contract — the
+    arguments are no longer ignored), the host spans as chrome-trace
+    JSON to ``<profile_path>.trace.json``, and the metrics registry as
+    Prometheus text exposition to ``<profile_path>.metrics.prom`` (the
+    ``snapshot_text`` dump a scrape-less run still wants on disk). The
+    provenance sidecar (``opprof_provenance.json``) lands next to the
+    xplane dumps so perf_report --roofline attributes offline. An
+    attached streaming sink is flushed so its JSONL tail is complete at
+    the moment the session ends."""
     global _device_trace_on
     if _device_trace_on:
         jax.profiler.stop_trace()
         _device_trace_on = False
+    op_table = None
+    if _trace_dir and flags.get_flag("opprof"):
+        from paddle_tpu.observability import opprof
+
+        try:
+            opprof.save_sidecar(_trace_dir)
+            op_table = opprof.attribute(_trace_dir)
+        except Exception:
+            op_table = None
+        if op_table is not None:
+            observability.set_gauge("opprof.attributed_frac",
+                                    op_table["attributed_frac"])
+            observability.set_gauge("opprof.unattributed_ms",
+                                    op_table["unattributed_ms"])
+            observability.set_gauge("opprof.comm_ms",
+                                    op_table["comm_ms"])
+            for tag, row in opprof.top_rows(op_table, top_k=20):
+                if row["ms"] > 0:
+                    observability.set_gauge("opprof.%s_ms" % tag,
+                                            row["ms"])
     table = summary_table(sorted_key)
+    if op_table is not None:
+        table += "\n\n" + op_summary_text(op_table)
     if profile_path:
         with open(profile_path, "w") as f:
             f.write(table + "\n")
@@ -114,7 +168,9 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
                                       key=lambda cm: -cm[1]):
                     if ms > 0:
                         f.write("#   %-16s %12.3f ms\n" % (cat, ms))
-    observability.flush_sink()
+    # snap=True: the opprof.* gauges just set (and the final goodput
+    # ledger) land in the sink's last snapshot for perf_report --merge
+    observability.flush_sink(snap=True)
     observability.set_enabled(None)  # back to the PADDLE_TPU_METRICS gate
     if _trace_dir:
         print("profiler: device trace in %s (TensorBoard/XProf; "

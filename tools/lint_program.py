@@ -35,7 +35,12 @@ update ON — the schedule gains the per-param all-gathers and the
 ledger reads post-sharding (near zero when the plan covers the
 optimizer state). ``--flags`` cross-references the README flags table
 against the flags.py DEFS registry and exits 1 on missing/stale rows.
-Exit code 1 iff any ERROR finding.
+``--provenance`` lints the opprof lowering provenance: every registered
+op type's ``pt.<type>.<block>_<idx>`` scope tag round-trips through
+``parse_tag``, a real mnist_mlp training compile covers every live op
+with a provenance entry + registry cost row and at least one tag lands
+in the compiled HLO op_metadata, and no paddle_tpu module imports from
+tools/ (library -> CLI layering). Exit code 1 iff any ERROR finding.
 
   python tools/lint_program.py --model mnist_mlp --spmd --mesh dp=2
   python tools/lint_program.py --model mnist_mlp --spmd --zero1
@@ -238,6 +243,133 @@ def _flags_doc_lint():
     return 1
 
 
+def _provenance_lint():
+    """The --provenance mode: three checks over the opprof lowering
+    provenance (observability/opprof.py).
+
+    (a) Every registered op type's scope tag survives the full jit path
+        join — ``parse_tag("jit(f)/.../pt.<type>.<b>_<i>/hlo")`` must
+        recover exactly the tag ``provenance_tag`` emitted.
+    (b) A real compile: run the mnist MLP one training step with the
+        opprof flag on and metrics enabled, then assert every live
+        (post-DCE) op in every compiled executable landed in the
+        provenance map, that at least one ``pt.*`` tag reached the
+        compiled HLO op_metadata, and that the opprof registry has a
+        cost row for every provenance tag.
+    (c) Layering: no module under paddle_tpu/ imports from tools/ (the
+        library must never depend on the CLI layer — tools/ shims like
+        xplane_top_ops.py point the other way).
+
+    Exit 1 on any failure.
+    """
+    import re
+
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu import observability as obs
+    from paddle_tpu.core.registry import OpRegistry
+    from paddle_tpu.observability import opprof
+
+    issues = []
+
+    # (a) tag round-trip for every registered op type
+    types = OpRegistry.all_types()
+    for t in types:
+        tag = opprof.provenance_tag(t, 0, 3)
+        path = "jit(run)/transpose(jvp(run))/%s/dot_general" % tag
+        if opprof.parse_tag(path) != tag or opprof.tag_op_type(tag) != t:
+            issues.append("op type %r: scope tag %r does not round-trip "
+                          "through parse_tag" % (t, tag))
+    print("provenance: %d registered op type(s) checked for scope-tag "
+          "round-trip" % len(types))
+
+    # (b) live compile coverage on the mnist MLP
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.executor import Executor
+    from paddle_tpu.framework import Program, program_guard
+
+    builders = _load_book_builders()
+    old_gen = unique_name.switch()
+    was_enabled = obs.enabled()
+    old_opprof = flags.get_flag("opprof")
+    try:
+        flags.set_flags({"opprof": True})
+        obs.set_enabled(True)
+        opprof.reset()
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            feeds, fetch, loss = builders["mnist_mlp"]()
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = Executor()
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main,
+                    feed={"img": rng.randn(8, 784).astype(np.float32),
+                          "label": np.ones((8, 1), np.int64)},
+                    fetch_list=[loss.name])
+        compiled = [cb for cb in exe.engine._cache.values()
+                    if getattr(cb, "provenance", None)]
+        if not compiled:
+            issues.append("mnist_mlp compile recorded no provenance map "
+                          "(opprof flag not threaded through _compile?)")
+        live_tags = set()
+        for cb in compiled:
+            block = cb.block_program.block
+            for i, op in enumerate(cb.block_program.ops):
+                tag = opprof.provenance_tag(
+                    op.type, getattr(block, "idx", 0), i)
+                live_tags.add(tag)
+                if tag not in cb.provenance:
+                    issues.append("live op %s #%d: no provenance entry "
+                                  "(expected tag %r)" % (op.type, i, tag))
+        snap = opprof.registry_snapshot()
+        if not snap["instr_tags"]:
+            issues.append("no pt.* scope tag reached the compiled HLO "
+                          "op_metadata (named_scope lost in lowering?)")
+        missing_costs = sorted(live_tags - set(snap["costs"]))
+        for tag in missing_costs:
+            issues.append("tag %r has no cost row in the opprof registry "
+                          "(register_executable skipped it)" % tag)
+        print("provenance: mnist_mlp compiled %d executable(s), %d live "
+              "op(s), %d tagged HLO instruction(s), %d cost row(s)"
+              % (len(compiled), len(live_tags), len(snap["instr_tags"]),
+                 len(snap["costs"])))
+    finally:
+        flags.set_flags({"opprof": old_opprof})
+        obs.set_enabled(was_enabled)
+        unique_name.switch(old_gen)
+
+    # (c) layering: the library never imports from the tools/ CLI layer
+    pat = re.compile(r"^\s*(?:from\s+tools\b|import\s+tools\b)", re.M)
+    n_scanned = 0
+    for dirpath, _dirs, files in os.walk(os.path.join(REPO_ROOT,
+                                                      "paddle_tpu")):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            n_scanned += 1
+            with open(path) as f:
+                if pat.search(f.read()):
+                    issues.append("%s imports from tools/ (library -> CLI "
+                                  "layering violation)"
+                                  % os.path.relpath(path, REPO_ROOT))
+    print("provenance: %d paddle_tpu module(s) scanned for tools/ imports"
+          % n_scanned)
+
+    if not issues:
+        print("\nprovenance lint: OK")
+        return 0
+    for issue in issues:
+        print("provenance lint: %s" % issue)
+    print("\nprovenance lint: %d issue(s)" % len(issues))
+    return 1
+
+
 def _freeze_report(main, startup, feed_names, fetch_names):
     """The --freeze report: run the real freeze + PTQ pipeline
     (inference/freeze.py, inference/quantize.py) over the built model and
@@ -431,6 +563,13 @@ def main(argv=None):
                         help="cross-reference the README flags table "
                              "against the flags.py DEFS registry and "
                              "exit 1 on missing/stale/duplicate rows")
+    parser.add_argument("--provenance", action="store_true",
+                        help="lint the opprof lowering provenance: every "
+                             "registered op type's scope tag round-trips "
+                             "through parse_tag, a real mnist_mlp compile "
+                             "covers every live op with a tagged HLO "
+                             "cost row, and no paddle_tpu module imports "
+                             "from tools/")
     parser.add_argument("--list-passes", action="store_true",
                         help="list every registered pass (name, kind, "
                              "default on/off) and exit")
@@ -449,6 +588,9 @@ def main(argv=None):
 
     if args.flags:
         return _flags_doc_lint()
+
+    if args.provenance:
+        return _provenance_lint()
 
     if args.mesh:
         # a Mesh over N>1 axes needs N host devices; force them before

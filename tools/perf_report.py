@@ -89,7 +89,7 @@ def render_host(rows):
 
 
 def render_device(xplane_dir, top_n):
-    from tools.xplane_top_ops import top_ops
+    from paddle_tpu.observability.opprof import top_ops
 
     rows, total = top_ops(xplane_dir, top_n=top_n)
     lines = ["", "== device: XLA-op time (total %.2f ms) ==" % total]
@@ -97,6 +97,78 @@ def render_device(xplane_dir, top_n):
         pct = (ms / total * 100) if total else 0.0
         lines.append("%10.3f ms  %5.1f%%  %s" % (ms, pct, name[:80]))
     return "\n".join(lines)
+
+
+def render_roofline(table, top_n):
+    """The per-op roofline table from an attribution result: top-k by
+    device time with %-of-step, arithmetic intensity (FLOPs/byte), the
+    compute/memory/comm-bound verdict, and the source-op list fused ops
+    expand to."""
+    from paddle_tpu.observability import opprof
+
+    lines = [
+        "== roofline: device time by framework op "
+        "(source %s, fusion policy %s) =="
+        % (table["source"], table["fusion_policy"]),
+        "%-36s %10s %6s %10s %-13s %s"
+        % ("op", "ms", "%", "FLOP/B", "verdict", "src_ops")]
+    shown = 0
+    for tag, row in opprof.top_rows(table, top_n):
+        if row["ms"] <= 0:
+            continue
+        shown += 1
+        lines.append(
+            "%-36s %10.3f %5.1f%% %10.2f %-13s %s"
+            % (tag[:36], row["ms"], 100.0 * row["frac"],
+               row["intensity"], row["verdict"],
+               ",".join(row["src_ops"])[:40]))
+    if not shown:
+        lines.append("(no device time attributed to any provenance tag "
+                     "— was the trace taken with PADDLE_TPU_OPPROF on?)")
+    zero = [t for t, r in table["ops"].items() if r["ms"] <= 0]
+    if zero:
+        lines.append("(+%d op(s) at 0 ms: fused away or constant-folded "
+                     "— e.g. %s)" % (len(zero), ", ".join(zero[:4])))
+    lines.append(
+        "attributed %.1f%% of %.3f ms device time "
+        "(unattributed %.3f ms, comm lane %.3f ms, %d/%d collective "
+        "instruction(s) vs registered schedule)"
+        % (100.0 * table["attributed_frac"], table["total_ms"],
+           table["unattributed_ms"], table["comm_ms"],
+           table["collective_instances"],
+           table["expected_collective_instances"]))
+    if table["source"] != "tpu":
+        lines.append("NOTE: CPU-plane attribution is coarse (durations "
+                     "include host dispatch) — verdicts are "
+                     "hardware-trustworthy on TPU traces only")
+    return "\n".join(lines)
+
+
+def roofline_report(xplane_dir, top_n=15, gate=False):
+    """-> (text, rc). Attribute the trace dir's device time per
+    provenance tag (using the opprof_provenance.json sidecar
+    stop_profiler wrote next to the xplane dumps) and render the
+    roofline table. With ``gate`` the rc is nonzero when the table is
+    empty or the collective lane disagrees with the registered HLO
+    schedule — wire into the bench flow the way multichip_probe
+    --predict is."""
+    from paddle_tpu.observability import opprof
+
+    try:
+        table = opprof.attribute(xplane_dir)
+    except Exception as e:
+        text = "roofline: attribution failed: %s" % e
+        return text, (1 if gate else 0)
+    text = render_roofline(table, top_n)
+    rc = 0
+    if gate:
+        issues = opprof.gate_issues(table)
+        for issue in issues:
+            text += "\nGATE: %s" % issue
+        rc = 1 if issues else 0
+        if not issues:
+            text += "\nroofline gate: PASS"
+    return text, rc
 
 
 # -- multi-host merge ------------------------------------------------------
@@ -121,7 +193,8 @@ def load_worker_dumps(dump_dir):
 
     def w(host):
         return workers.setdefault(
-            host, {"steps": {}, "hbm": {}, "goodput": {}, "job": None,
+            host, {"steps": {}, "hbm": {}, "goodput": {}, "opprof": {},
+                   "job": None,
                    "hb": {"count": 0, "last_ts": None, "last_step": None,
                           "step_ts": None},
                    "files": set(), "events": 0, "last_ts": None})
@@ -168,6 +241,10 @@ def load_worker_dumps(dump_dir):
                     # watermarks: keep the NEWEST value per host
                     if g.startswith("goodput.") or g.startswith("mfu."):
                         rec["goodput"][g] = v
+                    elif g.startswith("opprof."):
+                        # per-op device-time gauges stop_profiler set —
+                        # newest wins (they summarize the whole session)
+                        rec["opprof"][g] = v
     for rec in workers.values():
         rec["files"] = sorted(rec["files"])
     return workers
@@ -276,6 +353,49 @@ def render_merge(workers):
         lines.append("fleet max: " + "  ".join(
             "%s=%s" % (short[g], _fmt_bytes(fleet[g]))
             for g in HBM_GAUGES if g in fleet))
+    hot = render_fleet_hot_ops(workers)
+    if hot:
+        lines.append("")
+        lines.append(hot)
+    return "\n".join(lines)
+
+
+def render_fleet_hot_ops(workers, top_n=10):
+    """The fleet hot-ops table: per provenance tag, each rank's device
+    ms (from the ``opprof.<tag>_ms`` gauges stop_profiler streams into
+    the sink) plus the cross-rank spread — so a straggler is
+    attributable to an OP, not just a rank. Returns "" when no worker
+    carried opprof gauges."""
+    hosts = sorted(workers)
+    per_tag = {}  # tag -> {host: ms}
+    for h in hosts:
+        for g, v in workers[h]["opprof"].items():
+            if not g.endswith("_ms") or not g.startswith("opprof.pt."):
+                continue
+            tag = g[len("opprof."):-len("_ms")]
+            per_tag.setdefault(tag, {})[h] = float(v)
+    if not per_tag:
+        return ""
+    lines = ["== fleet hot ops (device ms per rank, opprof tags) =="]
+    hdr = ["op"] + ["h%s" % h for h in hosts] + ["spread"]
+    lines.append("%-36s" % hdr[0] + "  ".join("%9s" % c
+                                              for c in hdr[1:]))
+    ranked = sorted(per_tag.items(),
+                    key=lambda kv: -max(kv[1].values()))[:top_n]
+    for tag, per_host in ranked:
+        vals = [per_host.get(h) for h in hosts]
+        present = [v for v in vals if v is not None]
+        spread = (max(present) - min(present)) if len(present) > 1 \
+            else 0.0
+        lines.append("%-36s" % tag[:36] + "  ".join(
+            ("%9.3f" % v) if v is not None else "%9s" % "-"
+            for v in vals) + "  %9.3f" % spread)
+    fracs = [workers[h]["opprof"].get("opprof.attributed_frac")
+             for h in hosts]
+    if any(f is not None for f in fracs):
+        lines.append("attributed frac per rank: " + "  ".join(
+            "h%s=%.1f%%" % (h, 100.0 * f) for h, f in zip(hosts, fracs)
+            if f is not None))
     return "\n".join(lines)
 
 
@@ -403,7 +523,23 @@ def main(argv=None):
                    "goodput/badput-attribution table (per-rank goodput "
                    "%%, MFU, slowest badput category, fleet goodput %%, "
                    "and the supervisor's cross-incarnation job ledger)")
+    p.add_argument("--roofline", metavar="XPLANE_DIR", default=None,
+                   help="per-op roofline table from a profiled trace "
+                   "dir: top-k ops by device time with %% of step, "
+                   "arithmetic intensity, and compute/memory/comm-bound "
+                   "verdict (joins the opprof_provenance.json sidecar "
+                   "stop_profiler wrote against the xplane planes)")
+    p.add_argument("--gate", action="store_true",
+                   help="with --roofline: exit nonzero when the top-k "
+                   "table is empty or the collective lane disagrees "
+                   "with the registered HLO schedule (the bench-flow "
+                   "gate, like multichip_probe --predict)")
     args = p.parse_args(argv)
+    if args.roofline:
+        text, rc = roofline_report(args.roofline, top_n=args.top,
+                                   gate=args.gate)
+        print(text)
+        return rc
     if args.goodput:
         print(goodput_report(args.goodput))
         return 0
@@ -411,8 +547,8 @@ def main(argv=None):
         print(merge_report(args.merge))
         return 0
     if not args.host_trace:
-        p.error("either HOST_TRACE, --merge DIR, or --goodput DIR is "
-                "required")
+        p.error("either HOST_TRACE, --merge DIR, --goodput DIR, or "
+                "--roofline DIR is required")
     print(report(args.host_trace, args.xplane_dir, args.top))
     return 0
 

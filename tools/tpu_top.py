@@ -180,6 +180,29 @@ def render(state, path, metrics_lines=12, now_us=None):
         lines.append("goodput: %5.1f%% [%s]%s"
                      % (100.0 * frac, bar, detail))
 
+    hot = sorted(
+        ((k[len("opprof."):-len("_ms")], v) for k, v in gauges.items()
+         if k.startswith("opprof.pt.") and k.endswith("_ms") and v > 0),
+        key=lambda kv: -kv[1])
+    if hot:
+        # hot-ops panel: the opprof.<tag>_ms gauges stop_profiler set —
+        # device time per framework op, hottest first
+        total_hot = sum(v for _, v in hot)
+        parts = ["%s %.2fms" % (tag, v) for tag, v in hot[:4]]
+        afrac = gauges.get("opprof.attributed_frac")
+        lines.append(
+            "hot ops: %s%s"
+            % ("   ".join(parts),
+               ("   (attributed %.1f%%)" % (100.0 * afrac))
+               if afrac is not None else ""))
+        for tag, v in hot[:6]:
+            width = 28
+            filled = max(1, int(round(width * v / total_hot))) \
+                if total_hot else 0
+            lines.append("  %-34s %8.3fms [%s]"
+                         % (tag[:34], v, "#" * filled
+                            + "." * (width - filled)))
+
     if state.last_nan_inf is not None:
         args = state.last_nan_inf.get("args") or {}
         age_s = max(0.0, (now_us - state.last_nan_inf.get("ts", now_us))

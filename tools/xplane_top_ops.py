@@ -3,68 +3,26 @@
 trace-reading half of the profiler story (SURVEY §5), used in round 4 to
 find where the BERT engine step spends its time vs the probe.
 
+Thin CLI shim: the plane iterator and aggregation live in
+``paddle_tpu.observability.opprof`` (the package must never import from
+tools/); ``iter_planes``/``top_ops`` are re-exported here for
+back-compat with older scripts.
+
 Usage: PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
            python tools/xplane_top_ops.py <trace_dir> [top_n] [group]
 ``group``: 'op' (default, per fused-computation name) or 'kind'
 (collapse to the HLO opcode-ish prefix, e.g. fusion/copy/convolution).
 """
-import glob
-import re
+import os
 import sys
-from collections import defaultdict
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def iter_planes(trace_dir):
-    """Yield every non-empty DISTINCT plane from the .xplane.pb files
-    under ``trace_dir`` (shared by this tool and tools/timeline.py).
-    Byte-identical planes are skipped — some sessions embed the same
-    device plane in more than one dump file, which would double every
-    aggregate — while genuine multi-host planes (same name, different
-    events/timestamps) all pass through."""
-    import hashlib
-
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-    files = sorted(glob.glob("%s/**/*.xplane.pb" % trace_dir,
-                             recursive=True))
-    if not files:
-        raise FileNotFoundError("no xplane.pb under %s" % trace_dir)
-    seen = set()
-    for f in files:
-        xs = xplane_pb2.XSpace()
-        with open(f, "rb") as fh:
-            xs.ParseFromString(fh.read())
-        for plane in xs.planes:
-            if not sum(len(l.events) for l in plane.lines):
-                continue
-            digest = hashlib.sha256(
-                plane.SerializeToString(deterministic=True)).digest()
-            if digest in seen:
-                continue
-            seen.add(digest)
-            yield plane
-
-
-def top_ops(trace_dir, top_n=25, group="op"):
-    per = defaultdict(float)
-    total = 0.0
-    # aggregate over every host's trace file and every device plane
-    # (multi-core chips emit one plane per core)
-    for plane in iter_planes(trace_dir):
-        if "/device:" in plane.name:
-            meta = {m.id: m.name for m in plane.event_metadata.values()}
-            for line in plane.lines:
-                if line.name != "XLA Ops":
-                    continue
-                for e in line.events:
-                    name = meta.get(e.metadata_id, "?")
-                    if group == "kind":
-                        name = re.split(r"[.\d]", name, 1)[0]
-                    per[name] += e.duration_ps / 1e9
-                    total += e.duration_ps / 1e9
-    rows = sorted(per.items(), key=lambda kv: -kv[1])[:top_n]
-    return rows, total
-
+from paddle_tpu.observability.opprof import (  # noqa: E402,F401
+    iter_planes,
+    top_ops,
+)
 
 if __name__ == "__main__":
     d = sys.argv[1]
