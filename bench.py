@@ -1571,6 +1571,140 @@ def bench_opprof():
     return out
 
 
+def bench_reqtrace():
+    """Request-tracing cost triangle (observability/reqtrace.py):
+
+    * per-request instrumentation overhead, on (begin + the 4 serving
+      spans + tail verdict, dropped) vs off (the cached-bool
+      maybe_begin) — the ns the tail sampler charges a request that is
+      NOT kept, which is nearly all of them;
+    * kept-trace fraction under a Poisson load on a tiny served MLP at
+      2x its single-row rate with the slow threshold at ~4x p50 — what
+      fraction of production traffic the tail sampler would persist;
+    * exemplar-lookup round-trip ms: the sink written by that load,
+      loaded cold by tools/trace_query.py to resolve a latency
+      histogram's exemplar trace to its waterfall summary — the
+      SLO-page -> trace lookup an on-call actually performs.
+    """
+    import shutil
+    import tempfile
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags as _flags
+    from paddle_tpu import models
+    from paddle_tpu import observability as _obs
+    from paddle_tpu.inference import InferenceServer, freeze_program
+    from paddle_tpu.observability import reqtrace as _rt
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import trace_query
+
+    out = {}
+    n = 3000
+
+    def per_request_ns(reps=5):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _i in range(n):
+                ctx = _rt.maybe_begin(None)
+                if ctx is not None:
+                    _rt.add_span(ctx, "queue", 0.0, 1.0, rows=1)
+                    _rt.add_span(ctx, "coalesce", 0.0, 1.0)
+                    _rt.add_span(ctx, "dispatch", 0.0, 1.0)
+                    _rt.add_root_span(ctx, "request", 0.0, 1.0)
+                    _rt.tracer.finish(ctx, 0.0)
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best * 1e9
+
+    # off: both flags 0 -> one cached-bool check per request
+    _flags.set_flags({"trace_sample": 0.0, "trace_slow_ms": 0.0})
+    out["request_overhead_off_ns"] = round(per_request_ns(), 1)
+    # on (tail-buffered, verdict drops): slow threshold armed but never
+    # tripped, no head sampling -> the steady-state production cost
+    _flags.set_flags({"trace_slow_ms": 1e6, "trace_buffer": 8192})
+    out["request_overhead_on_ns"] = round(per_request_ns(), 1)
+    out["request_overhead_delta_ns"] = round(
+        out["request_overhead_on_ns"] - out["request_overhead_off_ns"], 1)
+
+    # -- kept fraction under Poisson load + the exemplar round-trip -----
+    main_p, startup, h = models.mnist.get_model(lr=0.01)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    frozen, _ = freeze_program(main_p, ["img"], [h["logits"].name],
+                               scope=scope)
+    rng = np.random.RandomState(0)
+
+    def one_row():
+        return {"img": rng.randn(1, 784).astype(np.float32)}
+
+    sink_dir = tempfile.mkdtemp(prefix="bench_reqtrace_")
+    sink = os.path.join(sink_dir, "serve.jsonl")
+    try:
+        srv = InferenceServer(frozen, ["img"], [h["logits"].name],
+                              scope=scope, executor=exe, buckets=(1, 4),
+                              max_wait_ms=2.0, name="reqtrace-bench")
+        with srv:
+            srv.warmup(one_row())
+            lat = []
+            for _ in range(20):
+                t0 = time.perf_counter()
+                srv.run(one_row())
+                lat.append((time.perf_counter() - t0) * 1000.0)
+            p50 = sorted(lat)[len(lat) // 2]
+            # metrics on explicitly (main() sets it too): the exemplar
+            # round-trip below reads the histogram exemplar slots out
+            # of the sink's final snapshot
+            _flags.set_flags({"metrics": True, "trace_sample": 0.05,
+                              "trace_slow_ms": max(5.0, 3.0 * p50)})
+            _obs.reset()
+            _obs.attach_sink(sink)
+            futs = []
+            t_end = time.monotonic() + 2.0
+            nxt = time.monotonic()
+            # past the coalescing batcher's absorption point, so the
+            # queue grows and a slow tail actually exists (the exemplar
+            # below must resolve to a KEPT trace) — but not so far that
+            # every request blows the threshold and the kept fraction
+            # saturates at 1.0
+            qps = 3000.0 / max(p50, 1e-3)
+            while True:
+                nxt += rng.exponential(1.0 / qps)
+                if nxt >= t_end:
+                    break
+                d = nxt - time.monotonic()
+                if d > 0:
+                    time.sleep(d)
+                futs.append(srv.submit(one_row()))
+            for f in futs:
+                f.result(timeout=600)
+            stats = _rt.stats()
+            _obs.detach_sink()
+        out["poisson_requests"] = stats["completed"]
+        out["kept_trace_frac"] = round(stats["kept_frac"], 4)
+        # exemplar round-trip: sink -> metric exemplar -> trace summary
+        t0 = time.perf_counter()
+        traces, _spans, snap = trace_query.load(
+            trace_query.expand_paths([sink], merge=True))
+        tid, _v = trace_query.exemplar_lookup(snap, "serving.request_ms")
+        found = tid is not None and tid in traces
+        if found:
+            trace_query.summarize(tid, traces[tid])
+        out["exemplar_lookup_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 2)
+        out["exemplar_resolved"] = bool(found)
+    finally:
+        for name in ("trace_sample", "trace_slow_ms", "trace_buffer"):
+            _flags.reset_flag(name)
+        shutil.rmtree(sink_dir, ignore_errors=True)
+    return out
+
+
 def main():
     from paddle_tpu import flags, observability
 
@@ -1821,6 +1955,14 @@ def main():
         result["counters"]["opprof"] = bench_opprof()
     except Exception as e:  # noqa: BLE001
         errors["opprof"] = str(e)[:200]
+    try:
+        # request-tracing cost triangle: per-request overhead on vs off
+        # (the disabled path must stay a cached-bool check), the kept-
+        # trace fraction under Poisson serving load, and the cold
+        # exemplar->waterfall lookup through tools/trace_query.py
+        result["counters"]["reqtrace"] = bench_reqtrace()
+    except Exception as e:  # noqa: BLE001
+        errors["reqtrace"] = str(e)[:200]
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

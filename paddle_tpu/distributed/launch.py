@@ -227,13 +227,26 @@ def supervise(script_args, nproc=1, started_port=6170,
     ledger = goodput_mod.JobLedger(attempt=0)
     gap_since = None     # monotonic ts the last gang exited
     gap_kind = None      # badput category for [gap_since, next launch)
+    # Job-level request trace: with tracing enabled (supervisor flags,
+    # or trace flags being exported to the workers) the whole job gets
+    # ONE trace ID, exported per incarnation via PADDLE_TPU_TRACE_ID so
+    # a restarted worker's spans join the same trace — the supervisor
+    # itself contributes the between-incarnation restart-gap spans.
+    rt = obs.reqtrace
+    trace_on = rt.enabled() or any(
+        str((env_extra or {}).get(k) or "") not in ("", "0", "0.0")
+        for k in ("PADDLE_TPU_TRACE_SAMPLE", "PADDLE_TPU_TRACE_SLOW_MS"))
+    job_trace = rt.begin(
+        flags_=rt.FLAG_SAMPLED | rt.FLAG_EAGER) if trace_on else None
 
     def _finish(rc):
         snap = ledger.snapshot()
         if stats is not None:
             stats.update(rc=rc, restarts=restarts, shrinks=shrinks,
                          preempts=preempts, final_nproc=nproc,
-                         lost_ranks=list(lost_ranks), goodput=snap)
+                         lost_ranks=list(lost_ranks), goodput=snap,
+                         trace_id=(job_trace.trace_id
+                                   if job_trace is not None else None))
         # direct tracer event: the job ledger is the incident record a
         # fleet rollup reads, so it lands in the supervisor's sink even
         # with metrics gated off
@@ -249,6 +262,8 @@ def supervise(script_args, nproc=1, started_port=6170,
         env = dict(env_extra or {})
         env["PADDLE_TPU_RESTART_COUNT"] = str(attempt)
         env["PADDLE_TPU_SHRINK_COUNT"] = str(shrinks)
+        if job_trace is not None:
+            rt.export_env(env, job_trace)
         if recovery_dir:
             env["PADDLE_TPU_RECOVERY_CKPT"] = recovery_dir
         monitor = None
@@ -262,6 +277,15 @@ def supervise(script_args, nproc=1, started_port=6170,
         if gap_since is not None:
             ledger.gap(gap_kind or "restart_downtime", gap_since,
                        t_launch, attempt=attempt)
+            if job_trace is not None:
+                # the supervisor's own span in the stitched trace: the
+                # dead air between the last gang's exit and this
+                # incarnation's launch, named with the badput category
+                rt.span_event(job_trace, "restart",
+                              rt.mono_to_epoch_us(gap_since),
+                              (t_launch - gap_since) * 1e6,
+                              kind=gap_kind or "restart_downtime",
+                              attempt=attempt)
             gap_since = None
         procs = launch_processes(script_args, nproc, started_port,
                                  node_ip, env_extra=env,

@@ -534,6 +534,12 @@ class Engine:
                 DeferredFetch(self.window, record, i, name=n)
                 for i, n in enumerate(record.fetch_names))
             obs.health.note_step_enqueued()
+            # async-window tracing: the enqueue half of the step, named
+            # with the ORIGINAL step so it correlates with the retire
+            # event that fires when the window resolves it (no-op
+            # unless a trace context is active on this thread)
+            obs.reqtrace.step_event("step_enqueue", self._run_counter,
+                                    depth=len(self.window))
             self.window.push(record, depth=dispatch_steps)
             return list(record.placeholders)
 

@@ -267,6 +267,10 @@ class DispatchWindow:
             # deferred guard tripped — the watchdog's retired counter
             # must advance either way (the rank is not hung, it blew up)
             obs.health.note_step_retired()
+            # the retire half of the async-window trace pair, named
+            # with the record's ORIGINAL step (enqueue order), so the
+            # dispatch-window gap is explicit in the trace
+            obs.reqtrace.step_event("step_retire", rec.step)
             if obs.enabled():
                 now = time.monotonic()
                 obs.inc("pipeline.steps_retired")

@@ -412,6 +412,32 @@ DEFS = {
         "partial sums stay inside the f32 mantissa). 'auto' = native "
         "everywhere except the CPU backend, where XLA's int8 codegen "
         "is slower than fp32."),
+    "trace_sample": (
+        float, 0.0,
+        "Head-sampling rate of the request tracer "
+        "(observability/reqtrace): this fraction of requests is kept "
+        "end to end regardless of the tail verdict, decided "
+        "deterministically from the trace ID so every process in a "
+        "distributed trace agrees. Tracing is active when this or "
+        "PADDLE_TPU_TRACE_SLOW_MS is > 0; both 0 (the default) keeps "
+        "the request path bit-exact untraced."),
+    "trace_slow_ms": (
+        float, 0.0,
+        "Tail-sampling latency threshold of the request tracer, in "
+        "ms: a completed request slower than this keeps its full "
+        "span buffer. Independent of the threshold, the tail verdict "
+        "also keeps errored requests and requests slower than 2x the "
+        "EWMA-smoothed p99 of recent completions. 0 = no fixed "
+        "threshold (the adaptive p99 rule still applies when tracing "
+        "is enabled via PADDLE_TPU_TRACE_SAMPLE)."),
+    "trace_buffer": (
+        int, 256,
+        "Max in-flight (started, not yet finished) traces the request "
+        "tracer buffers spans for; the oldest trace is evicted (and "
+        "counted in reqtrace.evicted) when a new one would exceed the "
+        "bound, so an abandoned request can never grow tracer memory "
+        "without limit. Each trace additionally caps its own span "
+        "list at 512 entries."),
 }
 
 _overrides = {}

@@ -67,13 +67,16 @@ def parse_buckets(spec=None):
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "t_enq")
+    __slots__ = ("feed", "rows", "future", "t_enq", "ctx")
 
-    def __init__(self, feed, rows):
+    def __init__(self, feed, rows, ctx=None):
         self.feed = feed
         self.rows = rows
         self.future = Future()
         self.t_enq = time.monotonic()
+        # request TraceContext (observability/reqtrace), or None when
+        # tracing is disabled / the request was not selected
+        self.ctx = ctx
 
 
 class InferenceServer:
@@ -165,16 +168,29 @@ class InferenceServer:
         return self
 
     # -- client API --------------------------------------------------------
-    def submit(self, feed):
+    def submit(self, feed, trace_id=None):
         """Enqueue one request; returns a concurrent.futures.Future
-        resolving to the fetch list (numpy, rows matching the request)."""
+        resolving to the fetch list (numpy, rows matching the request).
+
+        With request tracing enabled (``PADDLE_TPU_TRACE_SAMPLE`` /
+        ``PADDLE_TPU_TRACE_SLOW_MS``) the request opens a trace —
+        ``trace_id`` joins a caller-supplied trace (the FleetRouter
+        passes the ID it generated at routing time), otherwise one is
+        generated. The future carries ``trace_id`` plus the enqueue /
+        completion stamps ``t_enq`` / ``t_done`` (``time.monotonic()``,
+        the same clock ``health()`` ages dispatches with), so a client
+        can line its own latency measurement up against the trace."""
         from paddle_tpu import observability as obs
 
         if not self._started:
             raise RuntimeError("InferenceServer not started (use start() "
                                "or the context manager)")
         fd, rows = self._coerce(feed)
-        req = _Request(fd, rows)
+        req = _Request(fd, rows, ctx=obs.reqtrace.maybe_begin(trace_id))
+        req.future.trace_id = (req.ctx.trace_id if req.ctx is not None
+                               else None)
+        req.future.t_enq = req.t_enq
+        req.future.t_done = None
         with self._cond:
             if self._stopping:
                 raise RuntimeError("InferenceServer is stopping")
@@ -294,9 +310,14 @@ class InferenceServer:
     def _dispatch(self, batch):
         from paddle_tpu import observability as obs
 
+        rt = obs.reqtrace
         t_start = time.monotonic()
         rows = sum(r.rows for r in batch)
         bucket = self._bucket_for(rows)
+        traced = [r for r in batch if r.ctx is not None]
+        # fan-in is explicit: every member trace's batch spans name ALL
+        # the trace IDs coalesced into this bucket
+        members = [r.ctx.trace_id for r in traced] if traced else None
         if obs.enabled():
             with self._cond:
                 depth = len(self._queue)
@@ -304,25 +325,75 @@ class InferenceServer:
             obs.set_gauge("serving.queue_depth", depth)
             for r in batch:
                 obs.observe("serving.queue_ms",
-                            (t_start - r.t_enq) * 1000.0)
+                            (t_start - r.t_enq) * 1000.0,
+                            exemplar=(r.ctx.trace_id if r.ctx is not None
+                                      else None))
+        for r in traced:
+            rt.add_span(r.ctx, "queue", rt.mono_to_epoch_us(r.t_enq),
+                        (t_start - r.t_enq) * 1e6, rows=r.rows)
+        t_coal = t_start
         try:
             feed = self._coalesce(batch, rows, bucket)
+            t_coal = time.monotonic()
             outs = self._run_padded(feed, bucket)
             self._resolve(batch, outs, bucket)
         except BaseException as e:  # noqa: BLE001 - propagate per-request
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(e)
+            t_err = time.monotonic()
+            for r in traced:
+                # errored requests always keep their trace
+                r.future.t_done = t_err
+                total_ms = (t_err - r.t_enq) * 1000.0
+                rt.add_root_span(r.ctx, "request",
+                                 rt.mono_to_epoch_us(r.t_enq),
+                                 (t_err - r.t_enq) * 1e6, rows=r.rows,
+                                 bucket=bucket, error=repr(e)[:160],
+                                 total_ms=round(total_ms, 3))
+                rt.finish(r.ctx, total_ms, error=True)
             return
         t_done = time.monotonic()
         self._last_dispatch = t_done
+        for r in batch:
+            # the enqueue stamp was retained on the future at submit;
+            # completing on the same monotonic clock closes the pair
+            # (health()'s last_dispatch age, the trace spans, and a
+            # client-side latency measurement now all agree)
+            r.future.t_done = t_done
+        if traced:
+            engine_step = getattr(self._engine, "_run_counter", None)
+            coalesce_us = (t_coal - t_start) * 1e6
+            dispatch_us = (t_done - t_coal) * 1e6
+            for r in traced:
+                rt.add_span(r.ctx, "coalesce",
+                            rt.mono_to_epoch_us(t_start), coalesce_us,
+                            members=members, bucket=bucket, rows=rows)
+                rt.add_span(r.ctx, "dispatch",
+                            rt.mono_to_epoch_us(t_coal), dispatch_us,
+                            members=members, bucket=bucket,
+                            engine_step=engine_step)
+                total_ms = (t_done - r.t_enq) * 1000.0
+                rt.add_root_span(r.ctx, "request",
+                                 rt.mono_to_epoch_us(r.t_enq),
+                                 (t_done - r.t_enq) * 1e6, rows=r.rows,
+                                 bucket=bucket, engine_step=engine_step,
+                                 queue_ms=round(
+                                     (t_start - r.t_enq) * 1e3, 3),
+                                 coalesce_ms=round(
+                                     (t_coal - t_start) * 1e3, 3),
+                                 exec_ms=round((t_done - t_coal) * 1e3, 3),
+                                 total_ms=round(total_ms, 3))
+                rt.finish(r.ctx, total_ms)
         if self.slo is not None:
             # a sick SLO monitor must never take the dispatch loop down
             # (every queued future would hang unresolved)
             try:
                 for r in batch:
-                    self.slo.record((t_done - r.t_enq) * 1000.0,
-                                    now=t_done)
+                    self.slo.record(
+                        (t_done - r.t_enq) * 1000.0, now=t_done,
+                        trace_id=(r.ctx.trace_id if r.ctx is not None
+                                  else None))
             except Exception:
                 pass
         if obs.enabled():
@@ -335,15 +406,22 @@ class InferenceServer:
             # SLO burn monitor reacts to). Same decomposition as the
             # training ledger, at request granularity.
             frac_sum = 0.0
+            worst = None          # (frac, trace_id) exemplar candidate
             for r in batch:
                 total_ms = (t_done - r.t_enq) * 1000.0
                 frac = min(1.0, exec_ms / total_ms) if total_ms > 0 \
                     else 1.0
                 frac_sum += frac
-                obs.observe("serving.request_ms", total_ms)
+                if r.ctx is not None and (worst is None
+                                          or frac < worst[0]):
+                    worst = (frac, r.ctx.trace_id)
+                obs.observe("serving.request_ms", total_ms,
+                            exemplar=(r.ctx.trace_id
+                                      if r.ctx is not None else None))
                 obs.observe("serving.request_goodput", frac)
-            obs.set_gauge("goodput.serving_request_frac",
-                          frac_sum / len(batch))
+            obs.goodput.note_serving_request(
+                frac_sum / len(batch),
+                trace_id=worst[1] if worst is not None else None)
             obs.inc("serving.requests", len(batch))
             obs.inc("serving.batches")
             obs.inc("serving.padded_rows", bucket - rows)

@@ -34,6 +34,7 @@ from paddle_tpu.observability import (  # noqa: F401
     health,
     memory,
     opprof,
+    reqtrace,
 )
 from paddle_tpu.observability.export import (  # noqa: F401
     FlightRecorder,
@@ -57,7 +58,7 @@ __all__ = [
     "FlightRecorder", "JsonlSink", "MetricsRegistry", "SpanTracer",
     "attach_sink", "counter_value", "detach_sink", "dump_chrome_trace",
     "enabled", "event", "flush_sink", "goodput", "inc", "observe",
-    "opprof", "registry",
+    "opprof", "registry", "reqtrace",
     "health", "reset", "set_enabled", "set_gauge", "sink", "snapshot",
     "snapshot_text", "span", "spans", "time_block", "tracer",
 ]
@@ -186,14 +187,14 @@ def inc(name, n=1):
         registry.inc(name, n)
 
 
-def set_gauge(name, value):
+def set_gauge(name, value, exemplar=None):
     if _ENABLED:
-        registry.set_gauge(name, value)
+        registry.set_gauge(name, value, exemplar)
 
 
-def observe(name, value):
+def observe(name, value, exemplar=None):
     if _ENABLED:
-        registry.observe(name, value)
+        registry.observe(name, value, exemplar)
 
 
 def time_block(name):
@@ -254,3 +255,4 @@ def reset():
     tracer.reset()
     memory.reset_peaks()
     goodput.reset()
+    reqtrace.reset()

@@ -364,6 +364,19 @@ def replay_redirect():
     return redirected({"compute": "rollback_replay"})
 
 
+def note_serving_request(mean_frac, trace_id=None):
+    """Serving-side request goodput: publish the batch-mean executing
+    fraction as the ``goodput.serving_request_frac`` gauge, with the
+    WORST request's trace ID riding as the exemplar — the request-level
+    ledger entry links straight to the trace that wasted its wall.
+    Gated by the metrics flag (via obs.set_gauge), not the goodput
+    flag: serving has no interval ledger to keep consistent."""
+    from paddle_tpu import observability as obs
+
+    obs.set_gauge("goodput.serving_request_frac", mean_frac,
+                  exemplar=trace_id)
+
+
 def publish():
     """Refresh the ``goodput.*`` / ``mfu.*`` gauges (no-op when the
     flag is down; failures never propagate)."""

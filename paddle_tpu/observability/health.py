@@ -474,12 +474,21 @@ class SloMonitor:
         self._samples = collections.deque()  # (ts_s, latency_ms)
         self._lock = threading.Lock()
         self._burning = False
+        # worst SLO-violating (latency_ms, trace_id) seen so far — the
+        # exemplar a burn event names, linking the page to the request
+        # trace that spent the budget
+        self._exemplar = None
 
     # -- record ----------------------------------------------------------
-    def record(self, latency_ms, now=None):
+    def record(self, latency_ms, now=None, trace_id=None):
         now = time.monotonic() if now is None else now
         with self._lock:
             self._samples.append((now, float(latency_ms)))
+            if trace_id is not None and latency_ms > self.slo_ms \
+                    and (self._exemplar is None
+                         or latency_ms >= self._exemplar[0]):
+                self._exemplar = (float(latency_ms), trace_id)
+            exemplar = self._exemplar
             self._prune(now)
             fast = self._burn(now, self.fast_window_s)
             slow = self._burn(now, self.slow_window_s)
@@ -491,9 +500,13 @@ class SloMonitor:
 
             if burning:
                 obs.inc("health.slo_burn")
+                kw = {}
+                if exemplar is not None:
+                    kw["exemplar_ms"] = round(exemplar[0], 2)
+                    kw["exemplar_trace"] = exemplar[1]
                 obs.event("health.slo_burn", monitor=self.name,
                           slo_ms=self.slo_ms, burn_fast=round(fast, 2),
-                          burn_slow=round(slow, 2))
+                          burn_slow=round(slow, 2), **kw)
             else:
                 obs.event("health.slo_recovered", monitor=self.name,
                           slo_ms=self.slo_ms)
@@ -540,9 +553,13 @@ class SloMonitor:
             n = len(lats)
             p99 = lats[min(n - 1, int(0.99 * n))] if n else None
             bad = sum(1 for _, ms in self._samples if ms > self.slo_ms)
-            return {"slo_ms": self.slo_ms, "target": self.target,
-                    "requests": n, "violations": bad,
-                    "burn_fast": fast, "burn_slow": slow,
-                    "burning": fast >= self.fast_burn
-                    and slow >= self.slow_burn,
-                    "p99_ms": p99}
+            out = {"slo_ms": self.slo_ms, "target": self.target,
+                   "requests": n, "violations": bad,
+                   "burn_fast": fast, "burn_slow": slow,
+                   "burning": fast >= self.fast_burn
+                   and slow >= self.slow_burn,
+                   "p99_ms": p99}
+            if self._exemplar is not None:
+                out["exemplar"] = {"ms": round(self._exemplar[0], 2),
+                                   "trace_id": self._exemplar[1]}
+            return out

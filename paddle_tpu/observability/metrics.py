@@ -42,20 +42,25 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("value",)
+    __slots__ = ("value", "exemplar")
 
     def __init__(self):
         self.value = None
+        # (value, trace_id) of the most recent observation that carried
+        # an exemplar — the request-trace linkage slot.
+        self.exemplar = None
 
-    def set(self, v):
+    def set(self, v, exemplar=None):
         self.value = v
+        if exemplar is not None:
+            self.exemplar = (v, exemplar)
 
 
 class Histogram:
     """Exact count/total/min/max over all observations plus a bounded
     tail of recent samples for percentiles."""
 
-    __slots__ = ("count", "total", "min", "max", "samples")
+    __slots__ = ("count", "total", "min", "max", "samples", "exemplar")
 
     def __init__(self):
         self.count = 0
@@ -63,8 +68,11 @@ class Histogram:
         self.min = None
         self.max = None
         self.samples = []
+        # (value, trace_id) of the worst exemplar-carrying observation:
+        # the trace behind the bucket max, the one an SLO page wants.
+        self.exemplar = None
 
-    def record(self, v):
+    def record(self, v, exemplar=None):
         v = float(v)
         self.count += 1
         self.total += v
@@ -73,6 +81,9 @@ class Histogram:
         self.samples.append(v)
         if len(self.samples) > _HIST_TAIL:
             del self.samples[: len(self.samples) - _HIST_TAIL]
+        if exemplar is not None and (self.exemplar is None
+                                     or v >= self.exemplar[0]):
+            self.exemplar = (v, exemplar)
 
     def percentile(self, q):
         """Nearest-rank percentile over the bounded sample tail; a
@@ -120,19 +131,19 @@ class MetricsRegistry:
                 c = self._counters[name] = Counter()
             c.inc(n)
 
-    def set_gauge(self, name, value):
+    def set_gauge(self, name, value, exemplar=None):
         with self._lock:
             g = self._gauges.get(name)
             if g is None:
                 g = self._gauges[name] = Gauge()
-            g.set(value)
+            g.set(value, exemplar)
 
-    def observe(self, name, value):
+    def observe(self, name, value, exemplar=None):
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
                 h = self._histograms[name] = Histogram()
-            h.record(value)
+            h.record(value, exemplar)
 
     # -- read -------------------------------------------------------------
     def counter_value(self, name, default=0):
@@ -152,14 +163,26 @@ class MetricsRegistry:
     def snapshot(self):
         """Plain-dict view of everything recorded so far (safe to
         json.dumps). Values are copied out under the lock; the live
-        registry keeps recording."""
+        registry keeps recording. Gauges stay plain scalars — exemplar
+        slots land under a separate top-level ``"exemplars"`` key
+        (present only when at least one metric carries one) so every
+        existing consumer keeps reading scalar gauges."""
         with self._lock:
-            return {
+            snap = {
                 "counters": {k: c.value for k, c in self._counters.items()},
                 "gauges": {k: g.value for k, g in self._gauges.items()},
                 "histograms": {k: h.describe()
                                for k, h in self._histograms.items()},
             }
+            exemplars = {}
+            for coll in (self._gauges, self._histograms):
+                for k, m in coll.items():
+                    if m.exemplar is not None:
+                        exemplars[k] = {"value": m.exemplar[0],
+                                        "trace_id": m.exemplar[1]}
+            if exemplars:
+                snap["exemplars"] = exemplars
+            return snap
 
     def reset(self):
         with self._lock:
@@ -218,6 +241,15 @@ def snapshot_text(snap, prefix="paddle_tpu"):
                              % (m, q, _prom_value(h[q_key])))
         lines.append("%s_sum %s" % (m, _prom_value(h.get("total", 0.0))))
         lines.append("%s_count %s" % (m, _prom_value(h.get("count", 0))))
+    # Exemplar linkage as comment lines: classic text exposition has no
+    # exemplar syntax (that is OpenMetrics), so the trace IDs ride in
+    # ``# EXEMPLAR <series> <value> trace_id="<id>"`` comments — ignored
+    # by any Prometheus parser, greppable by an on-call.
+    for name, ex in sorted(snap.get("exemplars", {}).items()):
+        lines.append('# EXEMPLAR %s %s trace_id="%s"'
+                     % (_prom_name(prefix, name),
+                        _prom_value(ex.get("value")),
+                        ex.get("trace_id")))
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -88,6 +88,15 @@ class SpanTracer:
                 return
             self._spans.append(rec)
 
+    def add_record(self, rec):
+        """Record an externally built SpanRecord through the normal
+        sink/flight/in-memory routing — the request tracer
+        (observability/reqtrace) emits a kept trace's buffered spans
+        through this, so ``trace.*`` spans reach the JSONL sink, the
+        flight recorder, and the chrome-trace export exactly like
+        natively recorded spans."""
+        self._add(rec)
+
     # -- sink / flight recorder -------------------------------------------
     def attach_sink(self, sink):
         """Route finished spans to ``sink`` (export.JsonlSink protocol:

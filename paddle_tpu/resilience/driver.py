@@ -187,6 +187,8 @@ class ResilientDriver:
         obs.inc("recovery.rollback")
         obs.event("recovery.rollback", failed_step=failed_step,
                   restored_step=step, reason=str(exc)[:200])
+        obs.reqtrace.step_event("rollback", failed_step,
+                                restored_step=step)
         # window discard + writer join + restore: all wall the fault
         # cost, charged with the steps about to be replayed
         obs.goodput.mark("rollback_replay")
@@ -364,9 +366,23 @@ class ResilientDriver:
         without spending restart budget. The previous handler is
         restored on return."""
         self._install_sigterm()
+        # cross-process trace adoption: under a tracing supervisor the
+        # incarnation joins the job trace exported via
+        # PADDLE_TPU_TRACE_ID — eager spans (a killed incarnation's
+        # half of the trace must already be on disk), fenced by the
+        # incarnation number exactly like heartbeats. The context is
+        # activated thread-locally so the engine's dispatch-window
+        # enqueue/retire seams emit into the same trace.
+        tctx = obs.reqtrace.adopt_env()
+        if tctx is not None:
+            obs.reqtrace.span_event(tctx, "train_start",
+                                    obs.reqtrace.now_us(), 0.0,
+                                    n_steps=n_steps)
         try:
             return self._train_impl(batch_fn, n_steps, start_step, on_step)
         finally:
+            if tctx is not None:
+                obs.reqtrace.deactivate()
             self._restore_sigterm()
             if obs.goodput.enabled():
                 # final ledger state must reach the sink: a worker that
@@ -391,6 +407,7 @@ class ResilientDriver:
                                    scope=self.scope, step=start_step)
                 obs.inc("recovery.resume")
                 obs.event("recovery.resume", step=start_step)
+                obs.reqtrace.step_event("resume", start_step)
                 obs.goodput.mark("restart_downtime")
             else:
                 start_step = 0

@@ -247,9 +247,34 @@ class FleetRouter:
         with self._lock:
             return len(self.workers)
 
-    def submit(self, feed):
-        """Route one request; returns the worker's Future."""
-        return self._pick().submit(feed)
+    def submit(self, feed, trace_id=None):
+        """Route one request; returns the worker's Future.
+
+        With request tracing enabled the router is where the trace ID
+        is born (or adopted from the caller): the chosen worker's
+        ``submit(feed, trace_id=...)`` joins the same trace, and once
+        the worker has opened its span buffer the routing decision
+        lands in it as a ``route`` span — a degraded-fleet request
+        shows WHICH worker it was pinned to."""
+        from paddle_tpu import observability as obs
+
+        rt = obs.reqtrace
+        if not rt.enabled():
+            return self._pick().submit(feed)
+        trace_id = trace_id or rt.new_trace_id()
+        t0_us = rt.now_us()
+        w = self._pick()
+        fut = w.submit(feed, trace_id=trace_id)
+        with self._lock:
+            try:
+                widx = self.workers.index(w)
+            except ValueError:
+                widx = -1
+            n = len(self.workers)
+        rt.add_span_by_id(trace_id, "route", t0_us,
+                          rt.now_us() - t0_us, worker=widx, fleet=n,
+                          burning=bool(w.burning()))
+        return fut
 
     def _pick(self):
         with self._lock:

@@ -370,6 +370,12 @@ def run_supervisor(args):
         # voting, and blame are all worker-side — the supervisor only
         # sees the resulting LOST exit
         env_extra["PADDLE_TPU_SDC"] = "1"
+    if args.trace:
+        # request tracing across the process boundary: supervise()
+        # sees the flag in env_extra, opens one eager job trace, and
+        # exports PADDLE_TPU_TRACE_ID to every incarnation — a
+        # restarted worker's spans join the same trace (verdict below)
+        env_extra["PADDLE_TPU_TRACE_SAMPLE"] = "1"
     if args.layout:
         env_extra["PADDLE_TPU_LAYOUT"] = "nhwc"
     if args.zero1:
@@ -429,6 +435,7 @@ def run_supervisor(args):
     # sinks are host-tagged (metrics.jsonl -> metrics.h<rank>.jsonl).
     recoveries = []
     sentinel_events = []
+    trace_events = []
     for path in glob.glob(os.path.splitext(sink)[0] + "*"):
         with open(path) as f:
             for line in f:
@@ -445,6 +452,8 @@ def run_supervisor(args):
                     recoveries.append(name)
                 if name.startswith("sentinel."):
                     sentinel_events.append(ev)
+                if name.startswith("trace."):
+                    trace_events.append(ev)
     verdict["recovery_events"] = sorted(set(recoveries))
     if spec and not recoveries and verdict["restarts"] == 0:
         problems.append("no recovery events recorded for spec %r" % spec)
@@ -506,6 +515,42 @@ def run_supervisor(args):
             problems.append(
                 "preemption burned restart budget (recovery.restart "
                 "= %d, expected 0)" % verdict["restarts"])
+    if args.trace:
+        # the --trace acceptance bar: ONE stitched trace spans the
+        # whole chaosed job — the supervisor's trace ID was adopted by
+        # every incarnation (spans from >= 2 distinct incarnations when
+        # the gang restarted), with the supervisor's restart-gap span
+        # between them. All reconstructed from the sinks alone.
+        job_trace = stats.get("trace_id")
+        verdict["trace_id"] = job_trace
+        mine = [ev for ev in trace_events
+                if (ev.get("args") or {}).get("trace") == job_trace]
+        incs = sorted({(ev.get("args") or {}).get("incarnation")
+                       for ev in mine
+                       if (ev.get("args") or {}).get("incarnation")
+                       is not None})
+        names = sorted({str(ev.get("name", "")) for ev in mine})
+        verdict["trace"] = {"spans": len(mine), "incarnations": incs,
+                            "names": names}
+        if not job_trace:
+            problems.append("supervise() opened no job trace "
+                            "(stats carries no trace_id)")
+        elif not mine:
+            problems.append("no trace.* spans for job trace %s in the "
+                            "sinks" % job_trace)
+        else:
+            if verdict["restarts"] > 0 and len(incs) < 2:
+                problems.append(
+                    "gang restarted but the job trace has spans from "
+                    "incarnation(s) %r only — the respawned worker "
+                    "never joined the trace" % (incs,))
+            if verdict["restarts"] > 0 \
+                    and "trace.restart" not in names:
+                problems.append("job trace has no supervisor "
+                                "trace.restart span covering the gap")
+            if "trace.train_start" not in names:
+                problems.append("no worker ever adopted the job trace "
+                                "(missing trace.train_start)")
     # goodput attribution gate: the supervisor's job ledger must (a)
     # conserve — categories sum to wall clock within 1% — and (b) have
     # charged the injected fault's cost to the RIGHT badput category,
@@ -610,6 +655,14 @@ def main():
                              "this many PEER ranks' roots (quorum "
                              "restore coverage; pairs with a disk_fail "
                              "spec entry)")
+    parser.add_argument("--trace", action="store_true",
+                        help="cross-process tracing gate: the "
+                             "supervisor opens one job trace, every "
+                             "incarnation adopts it via "
+                             "PADDLE_TPU_TRACE_ID, and the verdict "
+                             "asserts one stitched trace spanning both "
+                             "incarnations of a killed worker with the "
+                             "supervisor's restart span between")
     parser.add_argument("--hang", action="store_true",
                         help="seeded spec injects worker_hang instead of "
                              "worker_kill — exercises the heartbeat "

@@ -194,7 +194,7 @@ def load_worker_dumps(dump_dir):
     def w(host):
         return workers.setdefault(
             host, {"steps": {}, "hbm": {}, "goodput": {}, "opprof": {},
-                   "job": None,
+                   "exemplars": {}, "job": None,
                    "hb": {"count": 0, "last_ts": None, "last_step": None,
                           "step_ts": None},
                    "files": set(), "events": 0, "last_ts": None})
@@ -245,6 +245,10 @@ def load_worker_dumps(dump_dir):
                         # per-op device-time gauges stop_profiler set —
                         # newest wins (they summarize the whole session)
                         rec["opprof"][g] = v
+                ex = (ev.get("metrics") or {}).get("exemplars") or {}
+                # exemplar slots pin the trace id of the worst request
+                # behind each latency series — newest snapshot wins
+                rec["exemplars"].update(ex)
     for rec in workers.values():
         rec["files"] = sorted(rec["files"])
     return workers
@@ -357,6 +361,37 @@ def render_merge(workers):
     if hot:
         lines.append("")
         lines.append(hot)
+    ex = render_exemplars(workers)
+    if ex:
+        lines.append("")
+        lines.append(ex)
+    return "\n".join(lines)
+
+
+def render_exemplars(workers):
+    """The metric→trace exemplar table: for each host that streamed
+    exemplar slots in its metric snapshots, the offending request's
+    trace id and the value it pinned — the lookup key for
+    ``tools/trace_query.py --trace ID``. Returns "" when no worker
+    carried exemplars."""
+    hosts = [h for h in sorted(workers) if workers[h]["exemplars"]]
+    if not hosts:
+        return ""
+    lines = ["== metric exemplars (worst request per series — "
+             "tools/trace_query.py --trace ID) =="]
+    hdr = ("host", "metric", "value", "trace")
+    lines.append("  ".join(["%6s" % hdr[0], "%-28s" % hdr[1],
+                            "%12s" % hdr[2], hdr[3]]))
+    for h in hosts:
+        for metric in sorted(workers[h]["exemplars"]):
+            slot = workers[h]["exemplars"][metric] or {}
+            val = slot.get("value")
+            lines.append("  ".join([
+                "%6s" % ("h%s" % h),
+                "%-28s" % metric[:28],
+                "%12s" % ("%.3f" % val if isinstance(val, (int, float))
+                          else "-"),
+                str(slot.get("trace_id", "-"))]))
     return "\n".join(lines)
 
 

@@ -32,6 +32,10 @@ Usage:
   python tools/serve_probe.py --autoscale              # elastic fleet:
       # spike trips the fast burn window, the FleetRouter scales out
       # before the slow window confirms, p99 recovers, nothing dropped
+  python tools/serve_probe.py --trace                  # tracing gate:
+      # every over-SLO request under 2x-capacity load leaves a kept
+      # trace whose span-sum matches the measured latency; a calm run
+      # keeps ~only head-sampled traces (see tools/trace_query.py)
 """
 
 import argparse
@@ -393,6 +397,189 @@ def probe_autoscale(args):
     return 0
 
 
+def probe_trace(args):
+    """Request-tracing acceptance gate (--trace): under the Poisson
+    sweep, every over-SLO request must have produced a KEPT trace in
+    the telemetry sink whose span-sum matches the latency the driver
+    measured on its own future, with the full waterfall (queue ->
+    coalesce -> dispatch) and the engine-step cross-reference
+    reconstructable from the sink alone; and a calm (well-under-
+    capacity) run must keep ~only head-sampled traces — the tail
+    sampler's whole bargain: everything when it matters, noise floor
+    when it doesn't.
+
+    Two phases on one server: "calm" at ~25% of the calibrated
+    capacity, then "overload" at 2x capacity (the queue grows, requests
+    blow the slow threshold, every one of them must leave a trace).
+    Latencies are measured from the future's own t_enq/t_done stamps —
+    the same monotonic clock the spans are cut from, so the span-sum
+    comparison is exact, which is precisely the regression this gate
+    pins (a dispatch that dropped the enqueue stamp would tear the two
+    clocks apart)."""
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu import observability as obs
+
+    if HERE not in sys.path:
+        sys.path.insert(0, HERE)
+    import trace_query
+
+    sink_dir = args.sink_dir or tempfile.mkdtemp(prefix="serve_trace_")
+    obs.set_enabled(True)
+    server, one_row, info = build_server(
+        args.model, int8=args.int8, calib_batches=args.calib_batches,
+        buckets=args.buckets, max_wait_ms=args.max_wait_ms,
+        seed=args.seed)
+    rng = np.random.RandomState(args.seed)
+    phases = {}
+    with server:
+        server.warmup(one_row())
+        # calibrate unloaded latency (tracing still off: the trace
+        # flags are set after, so calibration leaves no traces)
+        lat = []
+        for _ in range(20):
+            t0 = time.monotonic()
+            server.run(one_row())
+            lat.append((time.monotonic() - t0) * 1000.0)
+        p50 = float(np.median(lat))
+        slow_ms = args.serving_slo_ms or max(25.0, 8.0 * p50)
+        cap_qps = 1000.0 / max(p50, 1e-3)
+        # trace_buffer must exceed the overload phase's peak queue
+        # depth — an evicted in-flight trace emits nothing at finish
+        flags.set_flags({"metrics": True, "trace_slow_ms": slow_ms,
+                         "trace_sample": args.trace_sample,
+                         "trace_buffer": 16384})
+        def run_phase(phase, qps):
+            sink = os.path.join(sink_dir, "trace_%s.jsonl" % phase)
+            obs.reset()
+            obs.attach_sink(sink)
+            futs = []
+            t0 = time.monotonic()
+            t_end = t0 + args.duration
+            nxt = t0
+            while True:
+                nxt += rng.exponential(1.0 / qps)
+                if nxt >= t_end:
+                    break
+                d = nxt - time.monotonic()
+                if d > 0:
+                    time.sleep(d)
+                futs.append(server.submit(one_row()))
+            for f in futs:
+                f.result(timeout=600)
+            stats = obs.reqtrace.stats()
+            obs.detach_sink()
+            traces, _, _ = trace_query.load([sink])
+            phases[phase] = {"futs": futs, "sink": sink, "qps": qps,
+                             "traces": traces, "stats": stats}
+
+        run_phase("calm", max(1.0, 0.25 * cap_qps))
+        # "2x capacity" in offered load: the coalescing batcher's real
+        # capacity is a batch-size multiple of the single-row rate, so
+        # escalate the multiplier until the queue actually outruns the
+        # slow threshold (the final escalation is the scored phase;
+        # each gets its own sink so earlier attempts don't pollute it)
+        for mult in (2.0, 8.0, 32.0, 128.0):
+            run_phase("overload_x%g" % mult, mult * cap_qps)
+            phases["overload"] = phases.pop("overload_x%g" % mult)
+            over_seen = any(
+                f.t_done is not None
+                and (f.t_done - f.t_enq) * 1000.0 > slow_ms
+                for f in phases["overload"]["futs"])
+            if over_seen:
+                break
+    obs.set_enabled(None)
+
+    problems = []
+    # -- overload: every over-SLO request left a kept, exact,
+    #    reconstructable trace
+    over = phases["overload"]
+    n_over = 0
+    missing = []         # over-SLO but no kept trace in the sink
+    mismatched = []      # kept but span-sum disagrees with the future
+    incomplete = []      # kept but the waterfall is not reconstructable
+    for f in over["futs"]:
+        if f.t_done is None or f.trace_id is None:
+            continue
+        meas_ms = (f.t_done - f.t_enq) * 1000.0
+        if meas_ms <= slow_ms:
+            continue
+        n_over += 1
+        spans = over["traces"].get(f.trace_id)
+        if not spans:
+            missing.append(f.trace_id)
+            continue
+        s = trace_query.summarize(f.trace_id, spans)
+        child_sum = sum(s["phases"].get(p, 0.0)
+                        for p in ("queue", "coalesce", "dispatch"))
+        tol = max(1.0, 0.02 * meas_ms)
+        if (abs(s["total_ms"] - meas_ms) > tol
+                or abs(child_sum - meas_ms) > tol):
+            mismatched.append((f.trace_id, round(s["total_ms"], 3),
+                               round(child_sum, 3), round(meas_ms, 3)))
+            continue
+        root_args = ((s["root"] or {}).get("args") or {})
+        if (any(p not in s["phases"]
+                for p in ("queue", "coalesce", "dispatch"))
+                or root_args.get("engine_step") is None):
+            incomplete.append(f.trace_id)
+    if n_over == 0:
+        problems.append("overload phase produced no over-SLO request "
+                        "(offered %.1f qps vs slow_ms %.1f)"
+                        % (over["qps"], slow_ms))
+    if missing:
+        problems.append("%d over-SLO request(s) left no kept trace: %s"
+                        % (len(missing), missing[:5]))
+    if mismatched:
+        problems.append("%d trace(s) disagree with the measured "
+                        "latency (id, root_ms, span_sum_ms, "
+                        "measured_ms): %s"
+                        % (len(mismatched), mismatched[:3]))
+    if incomplete:
+        problems.append("%d trace(s) missing waterfall phases or the "
+                        "engine_step cross-ref: %s"
+                        % (len(incomplete), incomplete[:5]))
+
+    # -- calm: ~only head-sampled keeps (the noise floor)
+    calm = phases["calm"]
+    calm_n = len(calm["futs"])
+    calm_keeps = {tid: trace_query.summarize(tid, sp)["keep"]
+                  for tid, sp in calm["traces"].items()}
+    calm_unsampled = [t for t, k in calm_keeps.items() if k != "sampled"]
+    # tolerate stragglers (a GC pause can make one calm request
+    # genuinely slow — that keep is the tracer doing its job)
+    if len(calm_unsampled) > max(1, int(0.05 * calm_n)):
+        problems.append("calm phase kept %d non-head-sampled trace(s) "
+                        "of %d requests (want ~only sampled): %s"
+                        % (len(calm_unsampled), calm_n,
+                           sorted(set(calm_keeps.values()))))
+    if args.trace_sample > 0 and calm_n >= 30 and not calm_keeps:
+        problems.append("calm phase kept no traces at sample rate %g "
+                        "over %d requests" % (args.trace_sample, calm_n))
+
+    verdict = {
+        "slow_ms": round(slow_ms, 2),
+        "baseline_p50_ms": round(p50, 2),
+        "calm": {"requests": calm_n, "kept": len(calm_keeps),
+                 "kept_by": calm["stats"]["kept_by"]},
+        "overload": {"requests": len(over["futs"]),
+                     "over_slo": n_over,
+                     "kept": len(over["traces"]),
+                     "kept_by": over["stats"]["kept_by"],
+                     "evicted": over["stats"]["evicted"]},
+        "sink_dir": sink_dir,
+        "problems": problems,
+        "ok": not problems,
+    }
+    print("trace: " + json.dumps(verdict))
+    if problems:
+        sys.stderr.write("serving trace gate failed:\n  - "
+                         + "\n  - ".join(problems) + "\n")
+        return 1
+    return 0
+
+
 def slo_gate(rows, slo_ms, floor_qps):
     """Highest achieved QPS among levels meeting the p99 SLO; exit-1
     verdict when it undercuts the floor."""
@@ -443,9 +630,20 @@ def main(argv=None):
                          "under the SLO with zero dropped requests")
     ap.add_argument("--fleet-max", type=int, default=3,
                     help="FleetRouter max_workers for --autoscale")
+    ap.add_argument("--trace", action="store_true",
+                    help="request-tracing gate: every over-SLO request "
+                         "under a 2x-capacity Poisson load must leave "
+                         "a kept trace in the sink whose span-sum "
+                         "matches the measured latency; a calm run "
+                         "keeps ~only head-sampled traces")
+    ap.add_argument("--trace-sample", type=float, default=0.25,
+                    help="head-sample rate for the --trace gate's calm "
+                         "phase")
     args = ap.parse_args(argv)
     if args.autoscale:
         return probe_autoscale(args)
+    if args.trace:
+        return probe_trace(args)
     if args.check_health and args.serving_slo_ms is None:
         # an SLO so tight every served request violates it: the sweep
         # load IS the injected burn

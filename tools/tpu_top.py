@@ -52,6 +52,8 @@ class TopState:
         self.last_snap_ts = None
         self.last_nan_inf = None
         self.ranks = {}  # host id -> RankHealth (heartbeat liveness)
+        # kept request traces (reqtrace tail sampler): newest last
+        self.slow_traces = collections.deque(maxlen=8)
 
     def consume(self, ev):
         self.events += 1
@@ -67,6 +69,10 @@ class TopState:
                 self.total_steps += 1
             elif name == "nan_inf_trip":
                 self.last_nan_inf = ev
+            elif name == "trace.request":
+                # a kept trace's root span — the tail sampler only
+                # emits these for slow/errored/head-sampled requests
+                self.slow_traces.append(ev)
             elif name == HEARTBEAT_EVENT:
                 host = ev.get("host", 0)
                 rh = self.ranks.get(host)
@@ -213,6 +219,23 @@ def render(state, path, metrics_lines=12, now_us=None):
                               args.get("inf", 0), age_s))
     else:
         lines.append("nan/inf: none")
+
+    if state.slow_traces:
+        # slow-requests panel: the root spans of traces the tail
+        # sampler KEPT — each line is the trace_query lookup key
+        lines.append("slow requests (kept traces, newest last — "
+                     "tools/trace_query.py --trace ID):")
+        for ev in state.slow_traces:
+            a = ev.get("args") or {}
+            phases = [(ph, a.get(k)) for ph, k in
+                      (("queue", "queue_ms"), ("coalesce", "coalesce_ms"),
+                       ("exec", "exec_ms"))
+                      if isinstance(a.get(k), (int, float))]
+            dom = max(phases, key=lambda kv: kv[1])[0] if phases else "-"
+            total = a.get("total_ms", ev.get("dur", 0.0) / 1e3)
+            lines.append("  %s · %8.2f ms · %-8s %s"
+                         % (a.get("trace", "?"), total, dom,
+                            ("[%s]" % a["keep"]) if a.get("keep") else ""))
 
     if state.ranks:
         now_s = now_us / 1e6

@@ -1,0 +1,317 @@
+#!/usr/bin/env python
+"""trace_query — reconstruct request traces from telemetry sinks.
+
+Loads one or more JSONL sink files (or directories of them — rotation
+segments and host-tagged per-rank files included), groups the
+``trace.*`` spans the request tracer (observability/reqtrace) kept by
+trace ID, and renders:
+
+* ``--slowest N``   a table of the slowest kept traces with per-phase
+                    self-time (queue / coalesce / dispatch / ...), keep
+                    reason, and dominant phase;
+* ``--trace ID``    one trace's waterfall — each span as an offset +
+                    duration bar, batch fan-in members listed, and the
+                    device segment cross-referenced: the engine "step"
+                    span matching the dispatch's ``engine_step`` plus
+                    the hottest per-op device-time gauges
+                    (``opprof.pt.*``) from the last metrics snapshot;
+* ``--exemplar M``  the trace ID attached to metric ``M``'s exemplar
+                    slot (bucket-max observation) in the last snapshot,
+                    then that trace's waterfall — the SLO-page -> trace
+                    round trip.
+
+Everything is reconstructed FROM THE SINKS ALONE — the same files a
+fleet run ships — so the tool works post-mortem on any collected dump.
+
+Usage::
+
+    python tools/trace_query.py /tmp/run/metrics.jsonl --slowest 10
+    python tools/trace_query.py /tmp/run --merge --trace 4b5ad68fd6369c83
+    python tools/trace_query.py sink.jsonl --exemplar serving.request_ms
+    python tools/trace_query.py sink.jsonl --slowest 5 --json
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.observability.export import (  # noqa: E402
+    iter_events,
+    sink_file_set,
+)
+
+# phases rendered in causal order when present (anything else appends
+# in timestamp order)
+PHASE_ORDER = ("request", "route", "queue", "coalesce", "dispatch",
+               "restart", "train_start", "resume", "rollback",
+               "step_enqueue", "step_retire")
+
+
+def expand_paths(paths, merge=False):
+    """Sink args -> concrete file list. Directories expand to every
+    ``*.jsonl`` inside; ``--merge`` additionally globs each file arg's
+    whole family (``base*`` — host-tagged per-rank files and rotation
+    segments of a multi-process run)."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sink_file_set(p))
+            continue
+        if merge:
+            base = p
+            for ext in (".jsonl", ".json"):
+                if base.endswith(ext):
+                    base = base[: -len(ext)]
+                    break
+            fam = sorted(glob.glob(base + "*"))
+            for f in fam:
+                files.extend(sink_file_set(f))
+        else:
+            files.extend(sink_file_set(p))
+    # preserve order, drop duplicates (family globs overlap rotations)
+    seen, out = set(), []
+    for f in files:
+        if f not in seen:
+            seen.add(f)
+            out.append(f)
+    return out
+
+
+def load(files):
+    """-> (traces, engine_spans, last_snap) where traces is
+    {trace_id: [span event dict, ...]} for every ``trace.*`` span,
+    engine_spans is {step: event} for the host "step" spans (device
+    cross-ref), and last_snap is the final metrics snapshot seen."""
+    traces = {}
+    engine_spans = {}
+    last_snap = None
+    for path in files:
+        for ev in iter_events(path):
+            t = ev.get("t")
+            if t == "snap":
+                last_snap = ev.get("metrics") or last_snap
+                continue
+            if t != "span":
+                continue
+            name = str(ev.get("name", ""))
+            args = ev.get("args") or {}
+            if name.startswith("trace."):
+                tid = args.get("trace")
+                if tid:
+                    traces.setdefault(tid, []).append(ev)
+            elif name == "step" and args.get("step") is not None:
+                try:
+                    engine_spans[int(args["step"])] = ev
+                except (TypeError, ValueError):
+                    pass
+    return traces, engine_spans, last_snap
+
+
+def phase_of(ev):
+    return str(ev.get("name", ""))[len("trace."):]
+
+
+def summarize(tid, spans):
+    """One trace -> {id, total_ms, keep, phases: {phase: self_ms},
+    dominant, root, t0_us, t1_us, incarnations}."""
+    root = None
+    phases = {}
+    t0 = t1 = None
+    incarnations = set()
+    for ev in spans:
+        ph = phase_of(ev)
+        ts = float(ev.get("ts") or 0.0)
+        dur = float(ev.get("dur") or 0.0)
+        t0 = ts if t0 is None else min(t0, ts)
+        t1 = ts + dur if t1 is None else max(t1, ts + dur)
+        args = ev.get("args") or {}
+        if "incarnation" in args:
+            incarnations.add(args["incarnation"])
+        if ph == "request" and (root is None
+                                or dur > float(root.get("dur") or 0.0)):
+            root = ev
+            continue  # the root's wall overlaps every child; not self-time
+        phases[ph] = phases.get(ph, 0.0) + dur / 1e3
+    if root is not None:
+        total_ms = float(root.get("dur") or 0.0) / 1e3
+        keep = (root.get("args") or {}).get("keep")
+    else:
+        total_ms = ((t1 - t0) / 1e3) if t0 is not None else 0.0
+        keep = next(((ev.get("args") or {}).get("keep") for ev in spans
+                     if (ev.get("args") or {}).get("keep")), None)
+    dominant = max(phases.items(), key=lambda kv: kv[1])[0] \
+        if phases else None
+    return {"id": tid, "total_ms": total_ms, "keep": keep,
+            "phases": phases, "dominant": dominant, "root": root,
+            "t0_us": t0, "t1_us": t1,
+            "incarnations": sorted(incarnations)}
+
+
+def _phase_key(ev):
+    ph = phase_of(ev)
+    rank = PHASE_ORDER.index(ph) if ph in PHASE_ORDER else len(PHASE_ORDER)
+    return (float(ev.get("ts") or 0.0), rank)
+
+
+def render_waterfall(tid, spans, engine_spans=None, snap=None, width=36):
+    """Text waterfall: one line per span, offset + duration + a bar
+    positioned inside the trace's wall. The dispatch span's device
+    segment is cross-referenced via its ``engine_step`` arg."""
+    s = summarize(tid, spans)
+    t0 = s["t0_us"] or 0.0
+    span_wall = max(1e-9, (s["t1_us"] or t0) - t0)
+    lines = ["trace %s  total %.3f ms  keep=%s%s" % (
+        tid, s["total_ms"], s["keep"],
+        ("  incarnations=%s" % s["incarnations"]
+         if s["incarnations"] else ""))]
+    engine_step = None
+    for ev in sorted(spans, key=_phase_key):
+        ph = phase_of(ev)
+        ts = float(ev.get("ts") or 0.0)
+        dur = float(ev.get("dur") or 0.0)
+        args = dict(ev.get("args") or {})
+        if ph == "dispatch" and args.get("engine_step") is not None:
+            engine_step = args.get("engine_step")
+        off = max(0, int(round((ts - t0) / span_wall * width)))
+        w = max(1 if dur > 0 else 0,
+                int(round(dur / span_wall * width)))
+        w = min(w, width - min(off, width - 1))
+        bar = " " * min(off, width - 1) + ("#" * w if w else "|")
+        bar = bar[:width].ljust(width)
+        extras = []
+        for k in ("rows", "bucket", "worker", "members", "step",
+                  "engine_step", "kind", "attempt", "incarnation",
+                  "restored_step", "error"):
+            if k in args:
+                v = args[k]
+                if k == "members" and isinstance(v, list):
+                    v = ",".join(str(m)[:8] for m in v)
+                extras.append("%s=%s" % (k, v))
+        lines.append("  %-13s +%9.3fms %9.3fms [%s] %s" % (
+            ph, (ts - t0) / 1e3, dur / 1e3, bar,
+            " ".join(extras)))
+    if engine_step is not None and engine_spans:
+        dev = engine_spans.get(int(engine_step))
+        if dev is not None:
+            ts = float(dev.get("ts") or 0.0)
+            dur = float(dev.get("dur") or 0.0)
+            lines.append("  %-13s +%9.3fms %9.3fms (engine step %s)"
+                         % ("device:step", (ts - t0) / 1e3, dur / 1e3,
+                            engine_step))
+    if snap:
+        hot = sorted(
+            ((k[len("opprof."):], v)
+             for k, v in (snap.get("gauges") or {}).items()
+             if k.startswith("opprof.pt.") and k.endswith("_ms")
+             and isinstance(v, (int, float)) and v > 0),
+            key=lambda kv: -kv[1])[:3]
+        if hot:
+            lines.append("  device ops:   " + "   ".join(
+                "%s %.3fms" % (tag, v) for tag, v in hot))
+    return "\n".join(lines)
+
+
+def render_slowest(traces, n):
+    rows = sorted((summarize(t, sp) for t, sp in traces.items()),
+                  key=lambda r: -r["total_ms"])[:n]
+    out = ["%-18s %10s %-9s %-9s %s" % (
+        "trace", "total ms", "keep", "dominant", "per-phase self ms")]
+    for r in rows:
+        detail = "  ".join("%s %.3f" % (ph, ms) for ph, ms in sorted(
+            r["phases"].items(), key=lambda kv: -kv[1]))
+        out.append("%-18s %10.3f %-9s %-9s %s" % (
+            r["id"], r["total_ms"], r["keep"] or "-",
+            r["dominant"] or "-", detail))
+    return "\n".join(out), rows
+
+
+def exemplar_lookup(snap, metric):
+    """-> (trace_id, value) from the last snapshot's exemplar slots, or
+    (None, None)."""
+    ex = (snap or {}).get("exemplars") or {}
+    e = ex.get(metric)
+    if not e:
+        return None, None
+    return e.get("trace_id"), e.get("value")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("sinks", nargs="+",
+                    help="JSONL sink files or directories")
+    ap.add_argument("--merge", action="store_true",
+                    help="also load each sink's whole file family "
+                         "(host-tagged per-rank files + rotation "
+                         "segments: base*)")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="table of the N slowest kept traces")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="render one trace's waterfall")
+    ap.add_argument("--exemplar", default=None, metavar="METRIC",
+                    help="look up METRIC's exemplar trace in the last "
+                         "snapshot and render it")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    files = expand_paths(args.sinks, merge=args.merge)
+    if not files:
+        sys.stderr.write("trace_query: no sink files found\n")
+        return 1
+    traces, engine_spans, snap = load(files)
+    if not any((args.slowest, args.trace, args.exemplar)):
+        args.slowest = 10
+
+    if args.trace is not None:
+        spans = traces.get(args.trace)
+        if not spans:
+            sys.stderr.write("trace_query: trace %r not found in %d "
+                             "kept trace(s)\n" % (args.trace, len(traces)))
+            return 1
+        if args.json:
+            print(json.dumps(summarize(args.trace, spans),
+                             default=str))
+        else:
+            print(render_waterfall(args.trace, spans, engine_spans, snap))
+        return 0
+
+    if args.exemplar is not None:
+        tid, value = exemplar_lookup(snap, args.exemplar)
+        if tid is None:
+            sys.stderr.write("trace_query: metric %r carries no "
+                             "exemplar in the last snapshot\n"
+                             % args.exemplar)
+            return 1
+        spans = traces.get(tid)
+        if args.json:
+            out = {"metric": args.exemplar, "value": value, "trace": tid,
+                   "found": bool(spans)}
+            if spans:
+                out["summary"] = summarize(tid, spans)
+            print(json.dumps(out, default=str))
+        else:
+            print("exemplar of %s = %s -> trace %s"
+                  % (args.exemplar, value, tid))
+            if spans:
+                print(render_waterfall(tid, spans, engine_spans, snap))
+            else:
+                print("(trace %s was not kept in these sinks)" % tid)
+        return 0 if spans else 1
+
+    table, rows = render_slowest(traces, args.slowest)
+    if args.json:
+        print(json.dumps([{k: v for k, v in r.items() if k != "root"}
+                          for r in rows], default=str))
+    else:
+        print("%d kept trace(s) in %d file(s)" % (len(traces), len(files)))
+        print(table)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
