@@ -1705,6 +1705,167 @@ def bench_reqtrace():
     return out
 
 
+def bench_admission():
+    """Overload-protection cost triangle (inference/admission.py):
+
+    * submit-path overhead ns, protection off (the unguarded enqueue)
+      vs armed-but-admitting (bounded queue + deadline + predictive
+      gate checks that all pass) — what every request pays once the
+      stack is on;
+    * shed/reject/expire fractions under a 2s Poisson load at ~4x the
+      batcher's capacity with shedding armed and a live burn monitor —
+      how much traffic graceful degradation turns away to keep the
+      admitted p99 bounded (``rejected`` trends lower-is-better in
+      bench_diff: a regression here means the gate turns away traffic
+      the server could have served);
+    * hedge win rate on a two-worker fleet with one worker slowed 25x —
+      the fraction of hedged requests the fast replica actually wins.
+    """
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags as _flags
+    from paddle_tpu import models
+    from paddle_tpu.inference import (
+        DeadlineExceeded,
+        InferenceServer,
+        Rejected,
+        freeze_program,
+    )
+    from paddle_tpu.observability.health import SloMonitor
+    from paddle_tpu.resilience.elastic import FleetRouter
+
+    main_p, startup, h = models.mnist.get_model(lr=0.01)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    frozen, _ = freeze_program(main_p, ["img"], [h["logits"].name],
+                               scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.randn(1, 784).astype(np.float32)}
+
+    def mk_server(name, **kw):
+        return InferenceServer(frozen, ["img"], [h["logits"].name],
+                               scope=scope, executor=exe,
+                               buckets=(1, 4), max_wait_ms=2.0,
+                               name=name, **kw)
+
+    out = {}
+
+    def submit_ns(srv, reps=5, burst=64, **submit_kw):
+        best = float("inf")
+        for _ in range(reps):
+            futs = []
+            t0 = time.perf_counter()
+            for _i in range(burst):
+                futs.append(srv.submit(feed, **submit_kw))
+            dt = (time.perf_counter() - t0) / burst
+            for f in futs:
+                f.result(timeout=600)
+            best = min(best, dt)
+        return best * 1e9
+
+    # -- submit-path overhead: off vs armed-but-admitting ---------------
+    try:
+        srv = mk_server("adm-off")
+        with srv:
+            srv.warmup(feed)
+            out["submit_off_ns"] = round(submit_ns(srv), 1)
+        _flags.set_flags({"queue_limit": 100000, "serving_shed": True})
+        srv = mk_server("adm-on")   # flags are read at ctor
+        with srv:
+            srv.warmup(feed)
+            out["submit_armed_ns"] = round(
+                submit_ns(srv, deadline_ms=60000.0), 1)
+        out["submit_delta_ns"] = round(
+            out["submit_armed_ns"] - out["submit_off_ns"], 1)
+    finally:
+        for name in ("queue_limit", "serving_shed"):
+            _flags.reset_flag(name)
+
+    # -- turned-away fractions at 4x capacity with shedding live --------
+    try:
+        _flags.set_flags({"queue_limit": 32, "serving_shed": True})
+        mon = SloMonitor(10000.0, target=0.9, fast_window_s=1.0,
+                         slow_window_s=30.0, fast_burn=1.5,
+                         slow_burn=3.0, name="adm-bench")
+        srv = mk_server("adm-load", slo_monitor=mon)
+        with srv:
+            srv.warmup(feed)
+            lat = []
+            for _ in range(20):
+                t0 = time.perf_counter()
+                srv.run(feed)
+                lat.append((time.perf_counter() - t0) * 1000.0)
+            p50 = sorted(lat)[len(lat) // 2]
+            slo_ms = max(20.0, 5.0 * p50)
+            mon.slo_ms = slo_ms
+            qps = 4.0 * (4.0 / max(p50, 1e-3)) * 1000.0
+            futs, rejected, shed = [], 0, 0
+            t_end = time.monotonic() + 2.0
+            nxt = time.monotonic()
+            n = 0
+            while True:
+                nxt += rng.exponential(1.0 / qps)
+                if nxt >= t_end:
+                    break
+                d = nxt - time.monotonic()
+                if d > 0:
+                    time.sleep(d)
+                n += 1
+                try:
+                    futs.append(srv.submit(
+                        feed, deadline_ms=0.6 * slo_ms))
+                except Rejected as e:
+                    if e.reason == "shed":
+                        shed += 1
+                    else:
+                        rejected += 1
+            served, expired = [], 0
+            for f in futs:
+                try:
+                    f.result(timeout=600)
+                    served.append((f.t_done - f.t_enq) * 1000.0)
+                except DeadlineExceeded:
+                    expired += 1
+                except Rejected:
+                    shed += 1
+        out["overload_requests"] = n
+        out["rejected_frac"] = round(rejected / max(1, n), 4)
+        out["shed_frac"] = round(shed / max(1, n), 4)
+        out["expired_frac"] = round(expired / max(1, n), 4)
+        out["admitted_p99_ms"] = round(
+            float(np.percentile(served, 99)), 2) if served else None
+        out["admitted_slo_ms"] = round(slo_ms, 2)
+    finally:
+        for name in ("queue_limit", "serving_shed"):
+            _flags.reset_flag(name)
+
+    # -- hedge win rate against a 25x-slowed replica --------------------
+    s0 = mk_server("adm-slow")
+    s1 = mk_server("adm-fast")
+    orig_run = s0._run_padded
+
+    def slowed(feed_, bucket):
+        time.sleep(0.05)
+        return orig_run(feed_, bucket)
+
+    s0._run_padded = slowed
+    router = FleetRouter(lambda idx: (s0, s1)[idx], min_workers=2,
+                         max_workers=2, cooldown_s=3600.0,
+                         hedge_after_ms=10.0)
+    router.start()
+    try:
+        s1.warmup(feed)
+        for _ in range(40):
+            router.submit(feed).result(timeout=600)
+        out["hedges"] = router.hedges
+        out["hedge_win_frac"] = round(
+            router.hedge_wins / max(1, router.hedges), 4)
+    finally:
+        router.stop()
+    return out
+
+
 def main():
     from paddle_tpu import flags, observability
 
@@ -1963,6 +2124,14 @@ def main():
         result["counters"]["reqtrace"] = bench_reqtrace()
     except Exception as e:  # noqa: BLE001
         errors["reqtrace"] = str(e)[:200]
+    try:
+        # overload-protection cost triangle: the armed submit path's
+        # per-request overhead vs off, turned-away fractions + admitted
+        # p99 under 4x Poisson overload with shedding live, and the
+        # hedge win rate against a deliberately slowed replica
+        result["counters"]["admission"] = bench_admission()
+    except Exception as e:  # noqa: BLE001
+        errors["admission"] = str(e)[:200]
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

@@ -438,6 +438,63 @@ DEFS = {
         "bound, so an abandoned request can never grow tracer memory "
         "without limit. Each trace additionally caps its own span "
         "list at 512 entries."),
+    "queue_limit": (
+        int, 0,
+        "Bound on the continuous-batching server's request queue "
+        "(paddle_tpu.inference.admission): a submit that would push the "
+        "queue past this many entries first evicts already-expired "
+        "requests (CoDel-style, resolved with DeadlineExceeded), then "
+        "sheds a lower-priority entry if PADDLE_TPU_SERVING_SHED is on, "
+        "and finally raises Rejected('queue_full'). 0 = unbounded (the "
+        "exact pre-admission behavior)."),
+    "submit_retries": (
+        int, 0,
+        "Retry budget of FleetRouter.submit: a request whose worker "
+        "fails (dead at pick time, rejecting, or erroring mid-flight) "
+        "is re-submitted to another live worker up to this many times, "
+        "keeping one trace id across attempts with a trace.retry span "
+        "per relaunch. DeadlineExceeded is never retried (the deadline "
+        "is global). 0 = fail fast on the first worker's answer."),
+    "hedge_after_ms": (
+        float, 0.0,
+        "Straggler hedging threshold of FleetRouter.submit, in ms: a "
+        "routed request still unresolved after this long is "
+        "speculatively re-issued to a second live worker; the first "
+        "result wins and the loser is cancelled. Set it near the "
+        "fleet's p99 so only stragglers pay the duplicate compute. "
+        "0 = no hedging."),
+    "serving_shed": (
+        bool, False,
+        "Priority load shedding in the serving admission gate: while "
+        "the SLO fast window is burning, priority<=0 submissions are "
+        "shed (Rejected('shed')) — after the degraded executable has "
+        "been engaged, if one is configured — and a full bounded queue "
+        "may evict its lowest-priority entry to admit a "
+        "higher-priority newcomer. Off = priorities are recorded but "
+        "never acted on."),
+    "serving_degraded": (
+        bool, False,
+        "Degraded-mode fallback of the InferenceServer: when armed and "
+        "a degraded_program (e.g. the PR 8 int8 quantized program) was "
+        "passed at construction, a fast-window SLO burn switches "
+        "dispatch to the cheaper executable (own compile-cache entry "
+        "per bucket) and a confirmed slow-window recovery switches "
+        "back, emitting edge-triggered health.degraded_mode events. "
+        "Off = the fallback program is ignored."),
+    "fleet_breaker_failures": (
+        int, 0,
+        "Consecutive-failure trip threshold of the per-worker circuit "
+        "breaker in FleetRouter: this many failures in a row opens the "
+        "breaker and removes the worker from rotation until a "
+        "half-open probe succeeds after "
+        "PADDLE_TPU_FLEET_BREAKER_RESET_S. 0 = no breaker (workers "
+        "leave rotation only by dying or burning)."),
+    "fleet_breaker_reset_s": (
+        float, 5.0,
+        "Cool-down of an OPEN per-worker circuit breaker, in seconds: "
+        "after this long the breaker goes half-open and routes exactly "
+        "one probe request to the worker — success closes it, failure "
+        "re-opens it and restarts the cool-down."),
 }
 
 _overrides = {}

@@ -17,10 +17,23 @@ train-graph/serve-graph split of arXiv:1605.08695):
   LRU-cached executable per bucket, a max-wait timer bounding p99, SLO
   histograms in the metrics registry.
 
+Overload policy (admission.py): typed admission errors
+(``Rejected`` / ``DeadlineExceeded``), the bounded-queue +
+predictive-wait :class:`AdmissionGate`, and the per-worker
+:class:`CircuitBreaker` the FleetRouter trips sick workers with. All
+default-off.
+
 The classic predictor API (AnalysisConfig / create_paddle_predictor)
 lives in predictor.py and re-exports here unchanged.
 """
 
+from paddle_tpu.inference.admission import (  # noqa: F401
+    AdmissionError,
+    AdmissionGate,
+    CircuitBreaker,
+    DeadlineExceeded,
+    Rejected,
+)
 from paddle_tpu.inference.freeze import (  # noqa: F401
     FoldBatchNormPass,
     FreezeReport,
@@ -47,10 +60,11 @@ from paddle_tpu.inference.serving import (  # noqa: F401
 )
 
 __all__ = [
-    "AnalysisConfig", "AnalysisPredictor", "CalibrationStats",
-    "FoldBatchNormPass", "FreezeReport", "InferenceServer",
-    "PaddleTensor", "QUANTIZABLE_OPS", "QuantReport",
-    "StripTrainingPass", "calibrate_program", "create_paddle_predictor",
-    "freeze_program", "parse_buckets", "post_training_quantize",
-    "quantize_program",
+    "AdmissionError", "AdmissionGate", "AnalysisConfig",
+    "AnalysisPredictor", "CalibrationStats", "CircuitBreaker",
+    "DeadlineExceeded", "FoldBatchNormPass", "FreezeReport",
+    "InferenceServer", "PaddleTensor", "QUANTIZABLE_OPS", "QuantReport",
+    "Rejected", "StripTrainingPass", "calibrate_program",
+    "create_paddle_predictor", "freeze_program", "parse_buckets",
+    "post_training_quantize", "quantize_program",
 ]

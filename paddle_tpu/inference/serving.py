@@ -36,6 +36,23 @@ edge-triggered ``health.slo_burn`` event. ``health()`` is the probe a
 load balancer polls: worker liveness, queue depth, p99, burn rates,
 last-dispatch age.
 
+Overload protection (paddle_tpu/inference/admission.py — every knob
+defaults to OFF, leaving this path bit-identical to the unprotected
+build): requests may carry ``deadline_ms`` and ``priority``. A bounded
+queue (``PADDLE_TPU_QUEUE_LIMIT``) evicts already-expired entries
+CoDel-style before refusing; a predictive gate rejects a deadlined
+request at enqueue when its estimated wait (queued batches x EWMA
+batch latency) already exceeds the deadline; under SLO fast-window
+burn, priority<=0 traffic is shed (``PADDLE_TPU_SERVING_SHED``) —
+after dispatch has fallen back to a cheaper ``degraded_program``
+(``PADDLE_TPU_SERVING_DEGRADED``), when one is configured. ``Rejected``
+raises synchronously from ``submit``; ``DeadlineExceeded`` resolves
+onto the future of an admitted request that expired in the queue; the
+batcher skips expired entries as it pops them; ``run(timeout=)``
+cancels its queue entry instead of orphaning it. Counters:
+``serving.{rejected,shed,expired,cancelled}``; degraded-mode flips
+emit edge-triggered ``health.degraded_mode`` events.
+
 Concurrency note (PAPERS.md arXiv:2011.03641): keeping the device
 saturated comes from coalescing, not from parallel dispatch — a single
 worker feeding padded buckets to one async engine stream is the whole
@@ -45,8 +62,15 @@ model.
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
 
 import numpy as np
+
+from paddle_tpu.inference.admission import (
+    AdmissionGate,
+    DeadlineExceeded,
+    Rejected,
+)
 
 
 def parse_buckets(spec=None):
@@ -67,9 +91,10 @@ def parse_buckets(spec=None):
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "t_enq", "ctx")
+    __slots__ = ("feed", "rows", "future", "t_enq", "ctx",
+                 "deadline_ms", "t_deadline", "priority")
 
-    def __init__(self, feed, rows, ctx=None):
+    def __init__(self, feed, rows, ctx=None, deadline_ms=None, priority=0):
         self.feed = feed
         self.rows = rows
         self.future = Future()
@@ -77,6 +102,15 @@ class _Request:
         # request TraceContext (observability/reqtrace), or None when
         # tracing is disabled / the request was not selected
         self.ctx = ctx
+        self.deadline_ms = deadline_ms
+        # absolute expiry on the same monotonic clock as t_enq; None =
+        # the request waits forever (pre-deadline behavior)
+        self.t_deadline = (None if deadline_ms is None
+                           else self.t_enq + float(deadline_ms) / 1000.0)
+        self.priority = int(priority)
+
+    def expired(self, now):
+        return self.t_deadline is not None and now >= self.t_deadline
 
 
 class InferenceServer:
@@ -92,7 +126,8 @@ class InferenceServer:
 
     def __init__(self, program, feed_names, fetch_names, scope=None,
                  executor=None, buckets=None, max_wait_ms=None,
-                 name="serving", slo_ms=None, slo_monitor=None):
+                 name="serving", slo_ms=None, slo_monitor=None,
+                 degraded_program=None):
         from paddle_tpu import flags
         from paddle_tpu.executor import Executor, global_scope
         from paddle_tpu.observability.health import SloMonitor
@@ -128,6 +163,17 @@ class InferenceServer:
         self._started = False
         self._worker = None
         self._last_dispatch = None
+        # overload protection (inference/admission.py). Flags are read
+        # once at construction, like max_wait/buckets; at the defaults
+        # (queue_limit 0, shed off, no degraded program) every check
+        # below short-circuits and the request path is bit-identical to
+        # the pre-admission server.
+        self._adm = AdmissionGate()  # reads PADDLE_TPU_QUEUE_LIMIT
+        self._shed = bool(flags.get_flag("serving_shed"))
+        self.degraded_program = degraded_program
+        self._deg_enabled = bool(degraded_program is not None
+                                 and flags.get_flag("serving_degraded"))
+        self._degraded = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -162,13 +208,24 @@ class InferenceServer:
         (tiled to each edge) so the first live requests hit the cache
         instead of paying an XLA compile inside their latency budget."""
         example = {k: np.asarray(v) for k, v in example_feed.items()}
-        for edge in self.buckets:
-            feed = {k: self._tile(v, edge) for k, v in example.items()}
-            self._run_padded(feed, edge)
+        modes = (False, True) if self._deg_enabled else (False,)
+        was = self._degraded
+        try:
+            for degraded in modes:
+                # with a degraded fallback armed, pre-compile BOTH
+                # program's buckets — entering degraded mode under burn
+                # must not pay an XLA compile at the worst moment
+                self._degraded = degraded
+                for edge in self.buckets:
+                    feed = {k: self._tile(v, edge)
+                            for k, v in example.items()}
+                    self._run_padded(feed, edge)
+        finally:
+            self._degraded = was
         return self
 
     # -- client API --------------------------------------------------------
-    def submit(self, feed, trace_id=None):
+    def submit(self, feed, trace_id=None, deadline_ms=None, priority=0):
         """Enqueue one request; returns a concurrent.futures.Future
         resolving to the fetch list (numpy, rows matching the request).
 
@@ -179,28 +236,160 @@ class InferenceServer:
         generated. The future carries ``trace_id`` plus the enqueue /
         completion stamps ``t_enq`` / ``t_done`` (``time.monotonic()``,
         the same clock ``health()`` ages dispatches with), so a client
-        can line its own latency measurement up against the trace."""
+        can line its own latency measurement up against the trace.
+
+        ``deadline_ms`` bounds submit -> result: an admitted request
+        that expires in the queue resolves its future with
+        :class:`DeadlineExceeded`, and the predictive admission gate
+        refuses outright (``Rejected('predicted_late')``) when the
+        estimated queue wait already exceeds the deadline. ``priority``
+        orders load shedding (higher survives longer); it is inert
+        unless ``PADDLE_TPU_SERVING_SHED`` is on. A :class:`Rejected`
+        request raises here synchronously — no future, no trace."""
         from paddle_tpu import observability as obs
 
         if not self._started:
             raise RuntimeError("InferenceServer not started (use start() "
                                "or the context manager)")
         fd, rows = self._coerce(feed)
-        req = _Request(fd, rows, ctx=obs.reqtrace.maybe_begin(trace_id))
-        req.future.trace_id = (req.ctx.trace_id if req.ctx is not None
-                               else None)
-        req.future.t_enq = req.t_enq
-        req.future.t_done = None
+        now = time.monotonic()
+        evicted = []  # (_Request, exc): resolved after the lock drops
+        reject = None
         with self._cond:
             if self._stopping:
                 raise RuntimeError("InferenceServer is stopping")
-            self._queue.append(req)
-            obs.set_gauge("serving.queue_depth", len(self._queue))
-            self._cond.notify_all()
+            # 1) priority shedding under fast-window burn. With a
+            # degraded program configured, shedding only starts once
+            # the cheaper executable is already engaged — degrade
+            # first, drop second.
+            if (self._shed and priority <= 0
+                    and (self._degraded or not self._deg_enabled)
+                    and self.fast_burning(now=now)):
+                reject = Rejected("shed", trace_id=trace_id)
+            # 2) predictive gate: refuse a deadlined request whose
+            # estimated wait is already past its deadline.
+            elif deadline_ms is not None:
+                est = self._adm.predicted_wait_ms(
+                    sum(r.rows for r in self._queue), self.buckets[-1])
+                if est > float(deadline_ms):
+                    reject = Rejected(
+                        "predicted_late",
+                        "predicted wait %.1fms exceeds deadline %.1fms"
+                        % (est, float(deadline_ms)), trace_id=trace_id)
+            # 3) bounded queue: evict expired entries first
+            # (CoDel-style, oldest first by queue order), then shed a
+            # strictly-lower-priority entry, then refuse.
+            if reject is None and self._adm.over_limit(len(self._queue)):
+                keep = []
+                for r in self._queue:
+                    if r.expired(now):
+                        evicted.append((r, DeadlineExceeded(
+                            trace_id=r.future.trace_id,
+                            deadline_ms=r.deadline_ms,
+                            waited_ms=(now - r.t_enq) * 1000.0)))
+                    else:
+                        keep.append(r)
+                if len(keep) != len(self._queue):
+                    self._queue[:] = keep
+                if self._adm.over_limit(len(self._queue)):
+                    victim = None
+                    if self._shed and self._queue:
+                        v = min(self._queue,
+                                key=lambda r: (r.priority, r.t_enq))
+                        if v.priority < int(priority):
+                            victim = v
+                    if victim is not None:
+                        self._queue.remove(victim)
+                        evicted.append((victim, Rejected(
+                            "shed",
+                            "evicted for a priority-%d request"
+                            % int(priority),
+                            trace_id=victim.future.trace_id)))
+                    else:
+                        reject = Rejected("queue_full", trace_id=trace_id)
+            if reject is None:
+                req = _Request(fd, rows,
+                               ctx=obs.reqtrace.maybe_begin(trace_id),
+                               deadline_ms=deadline_ms, priority=priority)
+                req.future.trace_id = (req.ctx.trace_id
+                                       if req.ctx is not None else None)
+                req.future.t_enq = req.t_enq
+                req.future.t_done = None
+                self._queue.append(req)
+                obs.set_gauge("serving.queue_depth", len(self._queue))
+                self._cond.notify_all()
+        # resolve evicted futures outside the lock: their done-callbacks
+        # must never run under the server's condition variable
+        for r, exc in evicted:
+            self._finish_unserved(r, exc)
+        if reject is not None:
+            if obs.enabled():
+                obs.inc("serving.shed" if reject.reason == "shed"
+                        else "serving.rejected")
+            raise reject
         return req.future
 
     def run(self, feed, timeout=None):
-        return self.submit(feed).result(timeout)
+        """Blocking submit. A ``timeout`` that fires CANCELS the queue
+        entry (it will never be dispatched with the result discarded);
+        a request already handed to the batcher completes normally —
+        only the caller stopped waiting for it."""
+        fut = self.submit(feed)
+        try:
+            return fut.result(timeout)
+        except FutureTimeout:
+            self.cancel(fut)
+            raise
+
+    def cancel(self, future):
+        """Withdraw a still-queued request: removes the entry and
+        cancels its future. Returns False when the request already left
+        the queue (dispatched, resolved, or never ours) — dispatch is
+        the point of no return, matching the semantics clients expect
+        from ``concurrent.futures``."""
+        from paddle_tpu import observability as obs
+
+        req = None
+        with self._cond:
+            for i, r in enumerate(self._queue):
+                if r.future is future:
+                    req = self._queue.pop(i)
+                    obs.set_gauge("serving.queue_depth", len(self._queue))
+                    break
+        if req is None:
+            return False
+        t = time.monotonic()
+        req.future.t_done = t
+        req.future.cancel()
+        if obs.enabled():
+            obs.inc("serving.cancelled")
+        if req.ctx is not None:
+            obs.reqtrace.finish(req.ctx, (t - req.t_enq) * 1000.0,
+                                error=True)
+        return True
+
+    def _finish_unserved(self, req, exc):
+        """Resolve a queue entry that will never dispatch (expired or
+        evicted) with its typed admission error, closing its trace and
+        bumping the matching counter. Runs WITHOUT the server lock."""
+        from paddle_tpu import observability as obs
+
+        t = time.monotonic()
+        req.future.t_done = t
+        if not req.future.cancelled():
+            req.future.set_exception(exc)
+        if obs.enabled():
+            obs.inc("serving.expired" if isinstance(exc, DeadlineExceeded)
+                    else "serving.shed")
+        if req.ctx is not None:
+            rt = obs.reqtrace
+            total_ms = (t - req.t_enq) * 1000.0
+            rt.add_root_span(req.ctx, "request",
+                             rt.mono_to_epoch_us(req.t_enq),
+                             (t - req.t_enq) * 1e6, rows=req.rows,
+                             error=repr(exc)[:160],
+                             total_ms=round(total_ms, 3))
+            rt.finish(req.ctx, total_ms, error=True)
 
     def alive(self):
         """True while the dispatch worker thread is running — the cheap
@@ -260,6 +449,10 @@ class InferenceServer:
                "last_dispatch_age_s":
                    (now - self._last_dispatch)
                    if self._last_dispatch is not None else None}
+        if self._adm.queue_limit:
+            out["queue_limit"] = self._adm.queue_limit
+        if self._deg_enabled:
+            out["degraded"] = self._degraded
         healthy = alive
         if self.slo is not None:
             snap = self.slo.snapshot(now=now)
@@ -278,14 +471,20 @@ class InferenceServer:
             batch = self._collect()
             if batch is None:
                 return
+            if not batch:
+                # every popped entry had already expired — nothing to run
+                continue
             self._dispatch(batch)
 
     def _collect(self):
         """Block until a dispatchable batch exists: the top bucket is
         full, the oldest request's max-wait expired, or the server is
-        draining. Returns the popped requests (None = drained + stopped).
+        draining. Returns the popped requests (None = drained + stopped;
+        possibly empty when every popped entry had expired in queue —
+        those resolve with DeadlineExceeded instead of dispatching).
         """
         max_bucket = self.buckets[-1]
+        expired = []
         with self._cond:
             while not self._queue:
                 if self._stopping:
@@ -299,19 +498,39 @@ class InferenceServer:
                     break
                 self._cond.wait(remaining)
             batch, rows = [], 0
+            now = time.monotonic()
             while self._queue:
                 nxt = self._queue[0]
+                if nxt.expired(now):
+                    # admitted but dead on arrival at the batcher: skip
+                    # it rather than burn bucket rows on an answer the
+                    # client already gave up on
+                    expired.append(self._queue.pop(0))
+                    continue
                 if batch and rows + nxt.rows > max_bucket:
                     break
-                batch.append(self._queue.pop(0))
+                r = self._queue.pop(0)
+                # claim the future: a client that cancelled it directly
+                # (a hedge loser, a raced run(timeout=)) is dropped here
+                # instead of blowing up set_result() mid-batch and
+                # poisoning its batch-mates
+                if not r.future.set_running_or_notify_cancel():
+                    continue
+                batch.append(r)
                 rows += nxt.rows
-            return batch
+        for r in expired:
+            self._finish_unserved(r, DeadlineExceeded(
+                trace_id=r.future.trace_id, deadline_ms=r.deadline_ms,
+                waited_ms=(time.monotonic() - r.t_enq) * 1000.0))
+        return batch
 
     def _dispatch(self, batch):
         from paddle_tpu import observability as obs
 
         rt = obs.reqtrace
         t_start = time.monotonic()
+        if self._deg_enabled:
+            self._update_degraded(t_start)
         rows = sum(r.rows for r in batch)
         bucket = self._bucket_for(rows)
         traced = [r for r in batch if r.ctx is not None]
@@ -338,10 +557,12 @@ class InferenceServer:
             outs = self._run_padded(feed, bucket)
             self._resolve(batch, outs, bucket)
         except BaseException as e:  # noqa: BLE001 - propagate per-request
-            for r in batch:
-                if not r.future.done():
-                    r.future.set_exception(e)
             t_err = time.monotonic()
+            # close every member trace BEFORE resolving the futures: a
+            # done-callback may relaunch the SAME trace id on another
+            # worker (FleetRouter retry), and the relaunch must re-open
+            # a fresh span buffer — spans added to this one after the
+            # callback would be lost when finish() pops it
             for r in traced:
                 # errored requests always keep their trace
                 r.future.t_done = t_err
@@ -352,9 +573,15 @@ class InferenceServer:
                                  bucket=bucket, error=repr(e)[:160],
                                  total_ms=round(total_ms, 3))
                 rt.finish(r.ctx, total_ms, error=True)
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
             return
         t_done = time.monotonic()
         self._last_dispatch = t_done
+        # feed the admission gate's EWMA with the batch wall time —
+        # the predictive gate's wait estimate is depth x this
+        self._adm.note_batch((t_done - t_start) * 1000.0)
         for r in batch:
             # the enqueue stamp was retained on the future at submit;
             # completing on the same monotonic clock closes the pair
@@ -426,6 +653,27 @@ class InferenceServer:
             obs.inc("serving.batches")
             obs.inc("serving.padded_rows", bucket - rows)
 
+    def _update_degraded(self, now=None):
+        """Edge-triggered degraded-mode controller, evaluated once per
+        dispatch: ENTER on the fast burn window (early detection — the
+        same signal the fleet scales out on), EXIT only once the slow
+        window confirms recovery. The asymmetry is deliberate: flipping
+        executables is cheap (both are warm in the compile cache) but
+        flapping would make every latency sample bimodal."""
+        from paddle_tpu import observability as obs
+
+        if not self._degraded:
+            if self.fast_burning(now=now):
+                self._degraded = True
+                obs.inc("serving.degraded_entered")
+                obs.event("health.degraded_mode", server=self.name,
+                          engaged=True, burn=self.burn_snapshot(now=now))
+        elif (not self.fast_burning(now=now)
+              and self.slow_recovered(now=now)):
+            self._degraded = False
+            obs.event("health.degraded_mode", server=self.name,
+                      engaged=False, burn=self.burn_snapshot(now=now))
+
     # -- internals ---------------------------------------------------------
     def _coerce(self, feed):
         fd, rows = {}, None
@@ -465,11 +713,19 @@ class InferenceServer:
         return feed
 
     def _run_padded(self, feed, bucket):
+        # degraded mode swaps in the cheaper program under its own
+        # cache tag; with the mode off, both the program and the
+        # 3-tuple key are byte-identical to the pre-admission build
+        program = self.program
+        key = ("serving", self.name, bucket)
+        if self._degraded:
+            program = self.degraded_program
+            key = ("serving", self.name, bucket, "degraded")
         return self._engine.run_block(
-            self.program.desc, 0, self.scope,
+            program.desc, 0, self.scope,
             feed=feed, fetch_list=list(self.fetch_names),
             is_test=True, donate_state=False, state_writeback=False,
-            cache_key_extra=("serving", self.name, bucket),
+            cache_key_extra=key,
             return_numpy=True)
 
     def _resolve(self, batch, outs, bucket):

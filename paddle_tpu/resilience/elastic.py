@@ -37,10 +37,26 @@ arXiv:1605.08695):
 
 Every decision is observable: ``fleet.scale_out`` / ``fleet.scale_in``
 counters, ``health.fleet_scaled`` events, ``fleet.spawn_ms`` timing.
+
+Request protection (all knobs default off — ``submit`` then routes
+exactly as before): with ``PADDLE_TPU_SUBMIT_RETRIES`` > 0 a request
+whose worker fails (dead at pick time, rejecting at admission, or
+erroring mid-flight) is relaunched on another live worker under the
+SAME trace id, each relaunch stamped as a ``trace.retry`` span in the
+stitched trace; ``PADDLE_TPU_HEDGE_AFTER_MS`` speculatively re-issues
+stragglers to a second worker (first result wins, loser cancelled);
+``PADDLE_TPU_FLEET_BREAKER_FAILURES`` arms a per-worker circuit
+breaker (inference/admission.CircuitBreaker) that takes a
+consecutively-failing worker out of rotation and re-admits it through
+a single half-open probe after ``PADDLE_TPU_FLEET_BREAKER_RESET_S``.
+Counters: ``fleet.retry`` / ``fleet.hedge`` / ``fleet.hedge_win`` /
+``fleet.breaker_trips``; breaker flips emit ``health.breaker_open`` /
+``health.breaker_closed`` events.
 """
 
 import threading
 import time
+from concurrent.futures import Future
 
 from paddle_tpu import flags
 from paddle_tpu.resilience.faultinject import LOST_EXIT_CODE  # noqa: F401
@@ -143,7 +159,9 @@ class FleetRouter:
     """
 
     def __init__(self, factory, min_workers=None, max_workers=None,
-                 cooldown_s=None, clock=time.monotonic):
+                 cooldown_s=None, clock=time.monotonic, retries=None,
+                 hedge_after_ms=None, breaker_failures=None,
+                 breaker_reset_s=None):
         self.factory = factory
         self.min_workers = (int(flags.get_flag("fleet_min_workers"))
                             if min_workers is None else int(min_workers))
@@ -167,6 +185,23 @@ class FleetRouter:
         #: while the slow window was still quiet (tools/serve_probe.py
         #: --autoscale asserts exactly this)
         self.last_scale_out_burn = None
+        # request-protection envelope (all default 0/off -> the
+        # unprotected fast path, byte-identical routing to HEAD)
+        self.submit_retries = (int(flags.get_flag("submit_retries"))
+                               if retries is None else int(retries))
+        self.hedge_after_ms = (float(flags.get_flag("hedge_after_ms"))
+                               if hedge_after_ms is None
+                               else float(hedge_after_ms))
+        self.breaker_failures = (
+            int(flags.get_flag("fleet_breaker_failures"))
+            if breaker_failures is None else int(breaker_failures))
+        self.breaker_reset_s = (
+            float(flags.get_flag("fleet_breaker_reset_s"))
+            if breaker_reset_s is None else float(breaker_reset_s))
+        self.retries = 0        # relaunches actually performed
+        self.hedges = 0
+        self.hedge_wins = 0
+        self._breakers = {}     # id(worker) -> CircuitBreaker
         self._lock = threading.Lock()
         self._rr = 0
         self._spawned = 0
@@ -197,6 +232,7 @@ class FleetRouter:
             self._poll = None
         with self._lock:
             workers, self.workers = list(self.workers), []
+            self._breakers.clear()
         for w in workers:
             w.stop()
 
@@ -247,7 +283,7 @@ class FleetRouter:
         with self._lock:
             return len(self.workers)
 
-    def submit(self, feed, trace_id=None):
+    def submit(self, feed, trace_id=None, deadline_ms=None, priority=0):
         """Route one request; returns the worker's Future.
 
         With request tracing enabled the router is where the trace ID
@@ -255,28 +291,91 @@ class FleetRouter:
         ``submit(feed, trace_id=...)`` joins the same trace, and once
         the worker has opened its span buffer the routing decision
         lands in it as a ``route`` span — a degraded-fleet request
-        shows WHICH worker it was pinned to."""
+        shows WHICH worker it was pinned to.
+
+        ``deadline_ms`` / ``priority`` forward to the worker's
+        admission gate. With any protection knob armed (retry budget,
+        hedging, breaker) the returned future is the router's own:
+        failed attempts are relaunched on other live workers under the
+        same trace id, stragglers are optionally hedged, and the first
+        result wins."""
         from paddle_tpu import observability as obs
 
         rt = obs.reqtrace
+        if rt.enabled():
+            trace_id = trace_id or rt.new_trace_id()
+        if (self.submit_retries > 0 or self.hedge_after_ms > 0
+                or self.breaker_failures > 0):
+            return _GuardedSubmit(self, feed, trace_id, deadline_ms,
+                                  priority).start()
         if not rt.enabled():
-            return self._pick().submit(feed)
-        trace_id = trace_id or rt.new_trace_id()
+            return self._worker_submit(self._pick(), feed, trace_id,
+                                       deadline_ms, priority)
         t0_us = rt.now_us()
         w = self._pick()
-        fut = w.submit(feed, trace_id=trace_id)
-        with self._lock:
-            try:
-                widx = self.workers.index(w)
-            except ValueError:
-                widx = -1
-            n = len(self.workers)
+        fut = self._worker_submit(w, feed, trace_id, deadline_ms,
+                                  priority)
         rt.add_span_by_id(trace_id, "route", t0_us,
-                          rt.now_us() - t0_us, worker=widx, fleet=n,
-                          burning=bool(w.burning()))
+                          rt.now_us() - t0_us,
+                          worker=self._worker_index(w),
+                          fleet=self.n_workers, burning=bool(w.burning()))
         return fut
 
-    def _pick(self):
+    @staticmethod
+    def _worker_submit(w, feed, trace_id, deadline_ms, priority):
+        """Forward one request with only the kwargs the caller actually
+        supplied, so duck-typed workers that predate the
+        deadline/priority API keep working — and the default call stays
+        exactly ``w.submit(feed)``."""
+        kw = {}
+        if trace_id is not None:
+            kw["trace_id"] = trace_id
+        if deadline_ms is not None:
+            kw["deadline_ms"] = deadline_ms
+        if priority:
+            kw["priority"] = priority
+        return w.submit(feed, **kw)
+
+    def _worker_index(self, w):
+        with self._lock:
+            try:
+                return self.workers.index(w)
+            except ValueError:
+                return -1
+
+    def _breaker(self, w):
+        """The worker's CircuitBreaker, created on first use (None with
+        the breaker disabled)."""
+        if self.breaker_failures <= 0:
+            return None
+        from paddle_tpu.inference.admission import CircuitBreaker
+
+        with self._lock:
+            br = self._breakers.get(id(w))
+            if br is None:
+                br = CircuitBreaker(
+                    self.breaker_failures, self.breaker_reset_s,
+                    name=getattr(w, "name", "worker-%d" % id(w)),
+                    clock=self.clock)
+                self._breakers[id(w)] = br
+        return br
+
+    def _breaker_allows(self, w, now):
+        """May the breaker route to this worker? A True answer for a
+        half-open breaker CONSUMES the probe token, so only call this
+        for a worker that will actually be used on yes."""
+        if self.breaker_failures <= 0:
+            return True
+        with self._lock:
+            br = self._breakers.get(id(w))
+        return br is None or br.allow(now)
+
+    def _pick(self, exclude=None):
+        """Choose a worker: round-robin over live workers, preferring
+        (1) not burning + breaker closed, then (2) breaker closed, then
+        (3) any live worker — degraded service beats dropping the
+        request. ``exclude`` soft-avoids workers a retry already tried
+        (ignored when they are the only ones left)."""
         with self._lock:
             workers = list(self.workers)
             self._rr += 1
@@ -289,10 +388,16 @@ class FleetRouter:
         if not alive:
             raise RuntimeError("FleetRouter: no live workers in a fleet "
                                "of %d" % n)
-        # prefer workers not burning their SLO budget; if everyone is
-        # burning, degraded service still beats dropping the request
+        if exclude:
+            fresh = [w for w in alive if w not in exclude]
+            if fresh:
+                alive = fresh
+        now = self.clock()
         for w in alive:
-            if not w.burning():
+            if not w.burning() and self._breaker_allows(w, now):
+                return w
+        for w in alive:
+            if self._breaker_allows(w, now):
                 return w
         return alive[0]
 
@@ -336,6 +441,7 @@ class FleetRouter:
                 if len(self.workers) <= self.min_workers:
                     return 0
                 w = self.workers.pop()
+                self._breakers.pop(id(w), None)
                 size = len(self.workers)
                 self._last_scale = now
             w.stop()                     # drains its queue first
@@ -348,10 +454,17 @@ class FleetRouter:
         return 0
 
     def stats(self):
+        with self._lock:
+            breakers = list(self._breakers.values())
         return {"workers": self.n_workers, "scale_outs": self.scale_outs,
                 "scale_ins": self.scale_ins,
                 "last_spawn_ms": self.last_spawn_ms,
-                "last_scale_out_burn": self.last_scale_out_burn}
+                "last_scale_out_burn": self.last_scale_out_burn,
+                "retries": self.retries, "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "breaker_trips": sum(b.trips for b in breakers),
+                "breakers_open": sum(1 for b in breakers
+                                     if b.state != "closed")}
 
     def health(self):
         """Fleet-level readiness: per-worker snapshots plus the verdict
@@ -364,3 +477,215 @@ class FleetRouter:
                 "scale_outs": self.scale_outs,
                 "scale_ins": self.scale_ins,
                 "per_worker": snaps}
+
+
+class _GuardedSubmit:
+    """One routed request under the protection envelope.
+
+    The caller holds ONE outer future; underneath it the guard launches
+    worker attempts — the primary, bounded retries after failures, and
+    at most one hedge for a straggler. First successful attempt wins
+    the outer future and cancels the losers; the outer future fails
+    only once no attempt is left in flight and the retry budget is
+    spent (or the failure is a ``DeadlineExceeded``, which no other
+    worker can outrun — the deadline is global).
+
+    Trace stitching: every attempt submits under the SAME trace id, so
+    a retried request's spans from both workers land in one trace; the
+    ``trace.retry`` / ``trace.hedge`` span is added AFTER the relaunch
+    has re-opened the span buffer (the failed attempt's ``finish``
+    closed it), which is what makes the stitched timeline show the
+    hand-off."""
+
+    def __init__(self, router, feed, trace_id, deadline_ms, priority):
+        self.router = router
+        self.feed = feed
+        self.trace_id = trace_id
+        self.deadline_ms = deadline_ms
+        self.priority = priority
+        self.outer = Future()
+        self.outer.trace_id = trace_id
+        self.outer.t_enq = time.monotonic()
+        self.outer.t_done = None
+        self._lock = threading.Lock()
+        self._tried = []        # workers any attempt has been sent to
+        self._inflight = []     # inner futures not yet resolved
+        self._attempts_used = 0  # retry budget consumed
+        self._timer = None
+
+    def start(self):
+        err = self._attempt(first=True)
+        if err is not None:
+            self._retry(err)
+        if (self.router.hedge_after_ms > 0 and not self.outer.done()):
+            self._timer = threading.Timer(
+                self.router.hedge_after_ms / 1000.0, self._hedge)
+            self._timer.daemon = True
+            self._timer.start()
+        return self.outer
+
+    # -- attempts --------------------------------------------------------
+    def _attempt(self, first=False, hedge=False, worker=None):
+        """Send the request to one worker. Returns None when an attempt
+        is in flight (or already resolved), else the synchronous error
+        (nothing was launched)."""
+        r = self.router
+        if worker is None:
+            try:
+                worker = r._pick(exclude=None if first else self._tried)
+            except RuntimeError as e:
+                return e
+        self._tried.append(worker)
+        try:
+            inner = r._worker_submit(worker, self.feed, self.trace_id,
+                                     self.deadline_ms, self.priority)
+        except Exception as e:  # dead worker, Rejected, ...
+            br = r._breaker(worker)
+            if br is not None:
+                br.record_failure()
+            return e
+        self._note_route(worker, hedge)
+        if not hasattr(inner, "add_done_callback"):
+            # duck-typed worker answered synchronously with a value
+            self._resolve_ok(worker, inner, hedge)
+            return None
+        with self._lock:
+            self._inflight.append(inner)
+        inner.add_done_callback(
+            lambda f, w=worker, h=hedge: self._done(w, f, h))
+        return None
+
+    def _retry(self, exc):
+        """Consume one retry and relaunch; fails the outer future with
+        ``exc`` once the budget is spent or retrying cannot help."""
+        from paddle_tpu.inference.admission import DeadlineExceeded
+
+        r = self.router
+        if isinstance(exc, DeadlineExceeded):
+            self._maybe_fail(exc)
+            return
+        with self._lock:
+            if self._attempts_used >= r.submit_retries:
+                spent = True
+            else:
+                spent = False
+                self._attempts_used += 1
+                attempt = self._attempts_used
+        if spent:
+            self._maybe_fail(exc)
+            return
+        r.retries += 1
+        from paddle_tpu import observability as obs
+
+        obs.inc("fleet.retry")
+        err = self._attempt()
+        if err is None:
+            # the relaunch re-opened the trace buffer — the retry span
+            # lands inside the stitched trace
+            self._span("retry", attempt=attempt, error=repr(exc)[:120])
+        else:
+            self._retry(err)  # recursion bounded by the retry budget
+
+    def _hedge(self):
+        """Timer body: speculatively re-issue a straggler on a second
+        worker (skipped when no distinct live worker exists)."""
+        r = self.router
+        if self.outer.done():
+            return
+        try:
+            w = r._pick(exclude=self._tried)
+        except RuntimeError:
+            return
+        if w in self._tried:
+            return              # the straggler is the only worker left
+        r.hedges += 1
+        from paddle_tpu import observability as obs
+
+        obs.inc("fleet.hedge")
+        if self._attempt(hedge=True, worker=w) is None:
+            self._span("hedge", worker=r._worker_index(w))
+
+    # -- resolution ------------------------------------------------------
+    def _done(self, worker, fut, hedge):
+        r = self.router
+        with self._lock:
+            if fut in self._inflight:
+                self._inflight.remove(fut)
+        if fut.cancelled():
+            return              # a loser we cancelled ourselves
+        exc = fut.exception()
+        br = r._breaker(worker)
+        if br is not None:
+            if exc is None:
+                br.record_success()
+            else:
+                br.record_failure()
+        if exc is None:
+            self._resolve_ok(worker, fut.result(), hedge)
+        elif not self.outer.done():
+            self._retry(exc)
+
+    def _resolve_ok(self, worker, value, hedge):
+        try:
+            self.outer.t_done = time.monotonic()
+            self.outer.set_result(value)
+        except Exception:
+            return              # another attempt won the race
+        if hedge:
+            self.router.hedge_wins += 1
+            from paddle_tpu import observability as obs
+
+            obs.inc("fleet.hedge_win")
+        if self._timer is not None:
+            self._timer.cancel()
+        self._cancel_losers()
+
+    def _maybe_fail(self, exc):
+        """Fail the outer future — unless another attempt is still in
+        flight (it may yet win)."""
+        with self._lock:
+            if self._inflight:
+                return
+        if not self.outer.done():
+            try:
+                self.outer.t_done = time.monotonic()
+                self.outer.set_exception(exc)
+            except Exception:
+                pass
+        if self._timer is not None:
+            self._timer.cancel()
+
+    def _cancel_losers(self):
+        with self._lock:
+            losers = list(self._inflight)
+        for f in losers:
+            try:
+                f.cancel()
+            except Exception:
+                pass
+
+    # -- telemetry -------------------------------------------------------
+    def _note_route(self, worker, hedge):
+        from paddle_tpu import observability as obs
+
+        rt = obs.reqtrace
+        if self.trace_id is None or not rt.enabled():
+            return
+        r = self.router
+        args = {"worker": r._worker_index(worker),
+                "fleet": r.n_workers,
+                "burning": bool(worker.burning())}
+        if hedge:
+            args["hedge"] = True
+        rt.add_span_by_id(self.trace_id, "route", rt.now_us(), 0.0,
+                          **args)
+
+    def _span(self, phase, **args):
+        from paddle_tpu import observability as obs
+
+        rt = obs.reqtrace
+        if self.trace_id is None or not rt.enabled():
+            return
+        rt.add_span_by_id(self.trace_id, phase, rt.now_us(), 0.0,
+                          **{k: v for k, v in args.items()
+                             if v is not None})

@@ -47,7 +47,8 @@ def _clean_elastic():
     for name in ("mesh", "fault_spec", "max_shrinks", "max_restarts",
                  "ckpt_replicas", "fleet_min_workers",
                  "fleet_max_workers", "fleet_cooldown_s", "zero",
-                 "grad_bucket_mb"):
+                 "grad_bucket_mb", "submit_retries", "hedge_after_ms",
+                 "fleet_breaker_failures", "fleet_breaker_reset_s"):
         flags.reset_flag(name)
     faultinject.reset()
 
@@ -673,6 +674,194 @@ class TestFleetRouter:
             r.stop()
         assert all(w.stopped for w in [])    # stop() drained the fleet
         assert r.n_workers == 0
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter request protection: retries, hedging, circuit breaker
+# ---------------------------------------------------------------------------
+
+class _FutureWorker(_FakeWorker):
+    """A fake that answers like an InferenceServer: a Future per
+    submit, optional injected failure ('sync' raises from submit, True
+    resolves the future with an exception), optional straggling
+    (resolve=False leaves the future pending forever)."""
+
+    def __init__(self, idx):
+        super().__init__(idx)
+        self.fail = False
+        self.resolve = True
+        self.trace_ids = []
+        self.futures = []
+
+    def submit(self, feed, trace_id=None, deadline_ms=None, priority=0):
+        from concurrent.futures import Future
+
+        self.submitted.append(feed)
+        self.trace_ids.append(trace_id)
+        if self.fail == "sync":
+            raise RuntimeError("boom%d" % self.idx)
+        f = Future()
+        if self.fail:
+            f.set_exception(RuntimeError("boom%d" % self.idx))
+        elif self.resolve:
+            f.set_result("f%d" % self.idx)
+        self.futures.append(f)
+        return f
+
+
+def _frouter(**kw):
+    t = [0.0]
+    kw.setdefault("min_workers", 2)
+    kw.setdefault("max_workers", 2)
+    kw.setdefault("cooldown_s", 5.0)
+    r = FleetRouter(_FutureWorker, clock=lambda: t[0], **kw)
+    r.start()
+    return r, t
+
+
+class TestFleetProtection:
+    def test_trace_id_passthrough_without_tracing(self):
+        """Regression: the untraced fast path used to call
+        self._pick().submit(feed), silently dropping a caller-supplied
+        trace_id. It must forward."""
+        r, _ = _frouter(min_workers=1, max_workers=1)
+        fut = r.submit({"x": 1}, trace_id="abc123")
+        assert fut.result(timeout=5) == "f0"
+        assert r.workers[0].trace_ids == ["abc123"]
+        # and no kwargs at all keeps the legacy w.submit(feed) shape
+        # (duck-typed workers without the trace/deadline API)
+        assert r.submit({"x": 2}).result(timeout=5) == "f0"
+        assert r.workers[0].trace_ids[-1] is None
+
+    def test_pick_with_zero_workers(self):
+        r = FleetRouter(_FakeWorker, min_workers=1, max_workers=1)
+        with pytest.raises(RuntimeError, match="no workers"):
+            r._pick()                      # never started
+        r.start()
+        r.workers[0].stopped = True
+        with pytest.raises(RuntimeError, match="no live workers"):
+            r._pick()
+        with pytest.raises(RuntimeError, match="no live workers"):
+            r.submit({"x": 1})
+
+    def test_retry_on_sync_failure(self):
+        r, _ = _frouter(retries=1)
+        # round-robin picks workers[1] first (offset starts at 1)
+        r.workers[1].fail = "sync"
+        assert r.submit({"x": 1}).result(timeout=5) == "f0"
+        assert r.retries == 1
+        assert r.stats()["retries"] == 1
+
+    def test_retry_on_async_failure(self):
+        r, _ = _frouter(retries=1)
+        r.workers[1].fail = True           # future resolves to an error
+        assert r.submit({"x": 1}).result(timeout=5) == "f0"
+        assert r.retries == 1
+
+    def test_retry_budget_exhausted(self):
+        r, _ = _frouter(retries=1)
+        for w in r.workers:
+            w.fail = True
+        fut = r.submit({"x": 1})
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=5)
+        # primary + exactly one retry — the budget bounds the storm
+        assert sum(len(w.submitted) for w in r.workers) == 2
+
+    def test_deadline_exceeded_is_not_retried(self):
+        from paddle_tpu.inference import DeadlineExceeded
+
+        r, _ = _frouter(retries=3)
+
+        class _Expired(_FutureWorker):
+            def submit(self, feed, **kw):
+                from concurrent.futures import Future
+
+                self.submitted.append(feed)
+                f = Future()
+                f.set_exception(DeadlineExceeded(deadline_ms=1.0))
+                return f
+
+        r.workers[1] = _Expired(1)
+        r.workers[1].start()
+        fut = r.submit({"x": 1})
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=5)
+        # the deadline is global: no other worker can outrun it
+        assert r.retries == 0
+
+    def test_hedge_straggler_first_result_wins(self):
+        r, _ = _frouter(hedge_after_ms=10.0)
+        straggler = r.workers[1]
+        straggler.resolve = False          # never answers
+        fut = r.submit({"x": 1})
+        assert fut.result(timeout=5) == "f0"
+        assert r.hedges == 1 and r.hedge_wins == 1
+        # the loser was cancelled, not orphaned
+        assert straggler.futures[0].cancelled()
+
+    def test_hedge_skipped_with_single_worker(self):
+        r, _ = _frouter(min_workers=1, max_workers=1,
+                        hedge_after_ms=1.0)
+        r.workers[0].resolve = False
+        fut = r.submit({"x": 1})
+        time.sleep(0.1)                    # the timer fires into a
+        assert not fut.done()              # fleet with no second worker
+        assert r.hedges == 0
+        r.workers[0].futures[0].set_result("late")
+        assert fut.result(timeout=5) == "late"
+
+    def test_breaker_trips_and_half_open_recovers(self):
+        r, t = _frouter(retries=1, breaker_failures=2,
+                        breaker_reset_s=10.0)
+        sick = r.workers[1]
+        sick.fail = True
+        # two failed attempts trip the breaker...
+        for i in range(4):
+            assert r.submit({"x": i}).result(timeout=5) == "f0"
+        assert r.stats()["breaker_trips"] == 1
+        assert r.stats()["breakers_open"] == 1
+        # ...and remove the sick worker from rotation
+        seen = len(sick.submitted)
+        for i in range(4):
+            assert r.submit({"y": i}).result(timeout=5) == "f0"
+        assert len(sick.submitted) == seen
+        # cool-down passes, the fault clears: one half-open probe
+        # closes the breaker and the worker rejoins the rotation
+        t[0] += 11.0
+        sick.fail = False
+        for i in range(4):
+            r.submit({"z": i}).result(timeout=5)
+        assert len(sick.submitted) > seen
+        assert r.stats()["breakers_open"] == 0
+
+    def test_breaker_works_with_legacy_string_workers(self):
+        """Breaker-only protection must not break duck-typed workers
+        whose submit answers synchronously with a plain value."""
+        t = [0.0]
+        r = FleetRouter(_FakeWorker, min_workers=1, max_workers=1,
+                        cooldown_s=5.0, clock=lambda: t[0],
+                        breaker_failures=3)
+        r.start()
+        assert r.submit({"x": 1}).result(timeout=5) == "f0"
+
+    def test_protection_flags_flow_into_ctor(self):
+        flags.set_flags({"submit_retries": 2, "hedge_after_ms": 7.5,
+                         "fleet_breaker_failures": 4,
+                         "fleet_breaker_reset_s": 2.5})
+        r = FleetRouter(_FakeWorker)
+        assert r.submit_retries == 2
+        assert r.hedge_after_ms == 7.5
+        assert r.breaker_failures == 4
+        assert r.breaker_reset_s == 2.5
+        # and the defaults keep the whole envelope off
+        for name in ("submit_retries", "hedge_after_ms",
+                     "fleet_breaker_failures"):
+            flags.reset_flag(name)
+        r2 = FleetRouter(_FakeWorker)
+        assert r2.submit_retries == 0
+        assert r2.hedge_after_ms == 0.0
+        assert r2.breaker_failures == 0
 
 
 # ---------------------------------------------------------------------------

@@ -173,6 +173,37 @@ def test_stop_drains_pending_futures(served):
     assert fut.result(timeout=1)[0].shape == (2, 10)
 
 
+def test_run_timeout_cancels_queue_entry(served):
+    """Regression: a run(feed, timeout=) that times out used to leave
+    the request queued — the batcher still dispatched it later and the
+    result was silently discarded. The timeout must withdraw the queue
+    entry instead."""
+    obs.set_enabled(True)
+    try:
+        obs.reset()
+        # bucket 8 never fills; the 2s timer guarantees the entry is
+        # still queued when the 50ms client timeout fires
+        srv = _server(served, buckets=(8,), max_wait_ms=2000.0)
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        with srv:
+            srv.warmup(_mk(1))
+            obs.reset()
+            with pytest.raises(FutTimeout):
+                srv.run(_mk(1), timeout=0.05)
+            assert srv.health()["queue_depth"] == 0
+            # past the max-wait window: a dispatch of the orphan would
+            # have shown up in serving.requests by now
+            time.sleep(2.5)
+            assert obs.counter_value("serving.requests") == 0
+            assert obs.counter_value("serving.cancelled") == 1
+            # the server is still fully functional afterwards
+            assert srv.run(_mk(2), timeout=30)[0].shape == (2, 10)
+    finally:
+        obs.set_enabled(None)
+        obs.reset()
+
+
 def test_idle_and_burst_p99_bounded_by_max_wait(served):
     """The acceptance bound: at 0 QPS (a lone request against an idle
     server) and under a 4x-capacity burst, p99 stays within the max-wait

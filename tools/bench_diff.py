@@ -31,7 +31,8 @@ HIGHER = ("per_sec", "per_s", "speedup", "qps", "hit", "goodput",
           "savings_bytes")
 LOWER = ("_ms", "_bytes", "_ns", "miss", "evict", "trips", "crashes",
          "wall", "dropped", "failed", "skew", "spread", "overhead",
-         "badput", "retries", "transpose", "unattributed")
+         "badput", "retries", "transpose", "unattributed", "rejected",
+         "shed_", "expired")
 
 
 def direction(key):

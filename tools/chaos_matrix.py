@@ -1,10 +1,11 @@
 """Chaos matrix: every chaos acceptance gate in one command.
 
-Runs each ``tools/chaos_run.py`` gate as its own subprocess (distinct
-rendezvous ports, distinct workdirs), parses the one-line JSON verdict
-each gate prints, and renders a pass/fail table. Exit code 0 iff every
-gate passed — this is the single entry point CI (or a reviewer) runs to
-prove the whole failure-domain story at once:
+Runs each gate script (``tools/chaos_run.py`` for the training gang,
+``tools/serve_probe.py`` for the serving fleet) as its own subprocess
+(distinct rendezvous ports, distinct workdirs), parses the one-line
+JSON verdict each gate prints, and renders a pass/fail table. Exit
+code 0 iff every gate passed — this is the single entry point CI (or a
+reviewer) runs to prove the whole failure-domain story at once:
 
     gate      injected fault                   proven recovery path
     -------   ------------------------------   -------------------------
@@ -24,6 +25,14 @@ prove the whole failure-domain story at once:
               ZeRO-1 sharded Momentum update   partitioned velocity
               on the dp mesh (PADDLE_TPU_ZERO) slots; survivors keep
                                                fault-free parity
+    overload  4x sustained serving overload    admission control sheds;
+                                               queue stays bounded,
+                                               every future resolves,
+                                               admitted p99 holds SLO
+    hedge     serving-fleet worker killed      hedged retry answers via
+              mid-flight (+ a 0.5s straggler)  the survivor under ONE
+                                               stitched trace; breaker
+                                               trips, half-open recovers
 
 Usage::
 
@@ -50,39 +59,55 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 CHAOS_RUN = os.path.join(HERE, "chaos_run.py")
+SERVE_PROBE = os.path.join(HERE, "serve_probe.py")
 
-# name -> extra chaos_run.py argv. Ports are assigned below, spaced so
-# a lingering listener from one gate can never collide with the next.
+# (name, gate script, extra argv). Ports are assigned below, spaced so
+# a lingering listener from one gate can never collide with the next;
+# serve_probe gates are in-process (no rendezvous) and get only --seed.
 GATES = [
-    ("base", []),
-    ("hang", ["--hang"]),
+    ("base", CHAOS_RUN, []),
+    ("hang", CHAOS_RUN, ["--hang"]),
     # depth 4: the permanent loss lands MID async dispatch window, so
     # the in-flight deferred steps must retire/invalidate cleanly
     # before the survivors replay (the gang-level half of the live
     # shrink coverage; tests/test_elastic.py has the in-process half)
-    ("shrink", ["--shrink", "--dispatch-steps", "4"]),
-    ("quorum", ["--ckpt-replicas", "1", "--spec",
-                "disk_fail@rank0:step12;worker_kill@rank0:step14"]),
-    ("sdc", ["--sdc"]),
-    ("preempt", ["--preempt"]),
+    ("shrink", CHAOS_RUN, ["--shrink", "--dispatch-steps", "4"]),
+    ("quorum", CHAOS_RUN, ["--ckpt-replicas", "1", "--spec",
+                           "disk_fail@rank0:step12;"
+                           "worker_kill@rank0:step14"]),
+    ("sdc", CHAOS_RUN, ["--sdc"]),
+    ("preempt", CHAOS_RUN, ["--preempt"]),
     # conv probe + whole-program NHWC rewrite (analysis/layout.py): the
     # baked-HWIO filter rides the checkpoints through a permanent rank
     # loss mid dispatch window — the layout pass may not perturb
     # bit-exact replay under any recovery path
-    ("layout", ["--layout", "--shrink", "--dispatch-steps", "4"]),
+    ("layout", CHAOS_RUN, ["--layout", "--shrink",
+                           "--dispatch-steps", "4"]),
     # the ZeRO-1 sharded weight update on the dp mesh: the permanent
     # rank loss shrinks the workers' mesh while the Momentum velocity
     # slots live dp-sharded — the reshard-on-shrink seam must migrate
     # the partitioned optimizer state and keep fault-free parity
     # (tests/test_elastic.py has the in-process half of this coverage)
-    ("zero1", ["--shrink", "--mesh", "--zero1"]),
+    ("zero1", CHAOS_RUN, ["--shrink", "--mesh", "--zero1"]),
+    # the serving-side failure domain (paddle_tpu/inference/admission):
+    # sustained 4x overload against the armed admission stack — queue
+    # bounded, served/rejected/expired conserve exactly, admitted p99
+    # holds the SLO
+    ("overload", SERVE_PROBE, ["--overload", "--duration", "2"]),
+    # worker killed mid-flight behind the FleetRouter: hedged retries
+    # answer correctly via the survivor under one stitched trace, the
+    # sick worker's breaker trips, and a half-open probe recovers it
+    ("hedge", CHAOS_RUN, ["--serve-retry"]),
 ]
 
 
-def run_gate(name, extra, args, port):
-    cmd = [sys.executable, CHAOS_RUN, "--steps", str(args.steps),
-           "--nproc", str(args.nproc), "--seed", str(args.seed),
-           "--started_port", str(port)] + extra
+def run_gate(name, script, extra, args, port):
+    if script == SERVE_PROBE:
+        cmd = [sys.executable, script, "--seed", str(args.seed)] + extra
+    else:
+        cmd = [sys.executable, script, "--steps", str(args.steps),
+               "--nproc", str(args.nproc), "--seed", str(args.seed),
+               "--started_port", str(port)] + extra
     t0 = time.monotonic()
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
@@ -112,7 +137,7 @@ def main():
     parser.add_argument("--only", default=None,
                         help="comma-separated gate names to run "
                              "(default: all of %s)"
-                        % ",".join(n for n, _ in GATES))
+                        % ",".join(n for n, _, _ in GATES))
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--nproc", type=int, default=2)
     parser.add_argument("--seed", type=int, default=0)
@@ -126,17 +151,17 @@ def main():
     want = None
     if args.only:
         want = {n.strip() for n in args.only.split(",") if n.strip()}
-        unknown = want - {n for n, _ in GATES}
+        unknown = want - {n for n, _, _ in GATES}
         if unknown:
             parser.error("unknown gate(s): %s" % ", ".join(sorted(unknown)))
 
     rows = []
-    for i, (name, extra) in enumerate(GATES):
+    for i, (name, script, extra) in enumerate(GATES):
         if want is not None and name not in want:
             continue
         port = args.started_port + 16 * i
         print("chaos_matrix: running %-8s ..." % name, flush=True)
-        rows.append(run_gate(name, extra, args, port))
+        rows.append(run_gate(name, script, extra, args, port))
         row = rows[-1]
         print("chaos_matrix: %-8s %s in %.1fs"
               % (name, "PASS" if row["ok"] else "FAIL", row["wall_s"]),
@@ -149,8 +174,24 @@ def main():
     for r in rows:
         v = r["verdict"] or {}
         if r["ok"]:
-            detail = ",".join(v.get("sentinel_events")
-                              or v.get("recovery_events") or [])[:60]
+            if v.get("fleet"):          # the serving hedge/retry gate
+                f = v["fleet"]
+                detail = ("retries=%s hedge_wins=%s trips=%s stitched=%s"
+                          % (f.get("retries"), f.get("hedge_wins"),
+                             f.get("breaker_trips"),
+                             (v.get("traces") or {}).get("stitched")))
+            elif v.get("overload"):     # the serving overload gate
+                o = v["overload"]
+                turned = (sum((o.get("rejected") or {}).values())
+                          + o.get("shed_evicted", 0)
+                          + o.get("expired", 0))
+                detail = ("served=%s turned_away=%s depth_max=%s "
+                          "p99=%sms" % (o.get("served"), turned,
+                                        o.get("depth_max"),
+                                        o.get("served_p99_ms")))
+            else:
+                detail = ",".join(v.get("sentinel_events")
+                                  or v.get("recovery_events") or [])[:60]
             if v.get("goodput_attr"):
                 # where the injected fault's wall cost landed (asserted
                 # per-gate in chaos_run.py — this column is the summary)

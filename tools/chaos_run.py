@@ -614,6 +614,212 @@ def run_supervisor(args):
     return 0 if not problems else 1
 
 
+def run_serve_retry(args):
+    """Serving-fleet worker-kill-mid-flight gate (--serve-retry).
+
+    Two in-process ``InferenceServer`` workers over ONE frozen program
+    behind a ``FleetRouter`` with the full protection envelope (bounded
+    retries, a hedge timer, per-worker circuit breakers) and request
+    tracing at sample rate 1.0. The gate injects faults into worker 0's
+    device-dispatch seam (``_run_padded``) and asserts the router's
+    graceful-degradation story end to end:
+
+    * hedge — worker 0 made a 0.5s straggler: the hedge timer re-issues
+      on worker 1, the hedge wins, the client still gets the correct
+      answer, and the cancelled straggler must NOT poison worker 0's
+      batcher (the collect loop drops claimed-dead futures);
+    * retry — worker 0 killed mid-flight: every routed request still
+      resolves with the bit-correct result via worker 1; the failed and
+      relaunched attempts share ONE trace id, and the stitched trace
+      shows route spans on BOTH workers plus the ``trace.retry``
+      hand-off span; two consecutive failures trip worker 0's breaker;
+    * recover — fault cleared: after the breaker cooldown a half-open
+      probe routes one real request to worker 0, its success closes the
+      breaker, and worker 0 serves traffic again.
+
+    Prints the machine verdict as the last stdout line.
+    """
+    import time
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_query
+    from serve_probe import build_server
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import InferenceServer
+    from paddle_tpu.resilience.elastic import FleetRouter
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="serve_retry_")
+    os.makedirs(workdir, exist_ok=True)
+    sink = os.path.join(workdir, "events.jsonl")
+    problems = []
+    obs.set_enabled(True)
+    obs.reset()
+    flags.set_flags({"metrics": True, "trace_sample": 1.0,
+                     "trace_buffer": 16384})
+    obs.attach_sink(sink)
+    try:
+        s0, one_row, _ = build_server(
+            "mlp", int8=False, buckets="1,2", max_wait_ms=5.0,
+            seed=args.seed)
+        # the second worker wraps the SAME frozen program + scope: both
+        # workers are bit-identical replicas, so "the survivor answered
+        # correctly" is checkable against one executor reference
+        s1 = InferenceServer(s0.program, s0.feed_names, s0.fetch_names,
+                             scope=s0.scope, executor=s0._exe,
+                             buckets=(1, 2), max_wait_ms=5.0,
+                             name="probe-1")
+
+        # fault seam on worker 0's device dispatch
+        state = {"fail": False, "slow_s": 0.0, "served": 0, "fails": 0}
+        orig_run = s0._run_padded
+
+        def poisoned(feed, bucket):
+            if state["slow_s"]:
+                time.sleep(state["slow_s"])
+            if state["fail"]:
+                state["fails"] += 1
+                raise RuntimeError("injected device loss (chaos)")
+            out = orig_run(feed, bucket)
+            state["served"] += 1
+            return out
+
+        s0._run_padded = poisoned
+
+        rng = np.random.RandomState(args.seed)
+        feeds = [{"img": rng.randn(1, 784).astype(np.float32)}
+                 for _ in range(40)]
+        with fluid.scope_guard(s0.scope):
+            expected = [np.asarray(s0._exe.run(
+                s0.program, feed=f,
+                fetch_list=list(s0.fetch_names))[0]) for f in feeds]
+
+        router = FleetRouter(lambda idx: (s0, s1)[idx], min_workers=2,
+                             max_workers=2, cooldown_s=3600.0,
+                             retries=2, hedge_after_ms=150.0,
+                             breaker_failures=2, breaker_reset_s=1.0)
+        router.start()
+        try:
+            for srv in (s0, s1):
+                srv.warmup(feeds[0])
+
+            def drain(lo, hi, phase):
+                futs = [(i, router.submit(feeds[i]))
+                        for i in range(lo, hi)]
+                tids = []
+                for i, f in futs:
+                    try:
+                        got = f.result(timeout=60)
+                    except Exception as e:  # noqa: BLE001
+                        problems.append("%s: request %d failed: %r"
+                                        % (phase, i, e))
+                        continue
+                    tids.append(getattr(f, "trace_id", None))
+                    if not np.allclose(np.asarray(got[0]), expected[i],
+                                       rtol=1e-5, atol=1e-5):
+                        problems.append("%s: request %d answered "
+                                        "incorrectly" % (phase, i))
+                return tids
+
+            # -- phase 0: healthy fleet baseline
+            drain(0, 6, "healthy")
+
+            # -- phase 1: straggler -> hedge wins, answer still right
+            state["slow_s"] = 0.5
+            drain(6, 12, "hedge")
+            state["slow_s"] = 0.0
+            if router.hedge_wins < 1:
+                problems.append("0.5s straggler never lost to a hedge "
+                                "(hedges=%d wins=%d)"
+                                % (router.hedges, router.hedge_wins))
+            time.sleep(0.8)     # let worker 0 drain cancelled losers
+            if not s0.alive():
+                problems.append("worker 0's dispatch loop died on a "
+                                "cancelled hedge loser")
+
+            # -- phase 2: kill worker 0 mid-flight -> retries + breaker
+            state["fail"] = True
+            retries_before = router.retries
+            kill_tids = drain(12, 26, "kill")
+            stats = router.stats()
+            if router.retries <= retries_before:
+                problems.append("worker kill produced no retries")
+            if stats["breaker_trips"] < 1:
+                problems.append("repeated failures never tripped the "
+                                "breaker: %s" % stats)
+            if stats["breakers_open"] < 1:
+                problems.append("breaker not open right after the kill "
+                                "phase: %s" % stats)
+            served_sick = state["served"]
+
+            # -- phase 3: clear the fault -> half-open probe recovers
+            state["fail"] = False
+            time.sleep(1.2)     # past breaker_reset_s
+            drain(26, 40, "recover")
+            stats = router.stats()
+            if stats["breakers_open"] != 0:
+                problems.append("breaker still open after recovery: %s"
+                                % stats)
+            if state["served"] <= served_sick:
+                problems.append("worker 0 never served again after the "
+                                "fault cleared")
+            fleet = {"retries": router.retries, "hedges": router.hedges,
+                     "hedge_wins": router.hedge_wins,
+                     "breaker_trips": stats["breaker_trips"],
+                     "worker0_served": state["served"],
+                     "worker0_fails": state["fails"]}
+        finally:
+            router.stop()
+    finally:
+        obs.detach_sink()
+        for name in ("trace_sample", "trace_buffer", "metrics"):
+            flags.reset_flag(name)
+        obs.set_enabled(None)
+        obs.reset()
+
+    # -- stitched-trace audit: the retried request is ONE trace showing
+    # the failed attempt, the hand-off, and the serving attempt
+    traces, _, _ = trace_query.load([sink])
+    retry_traces = {tid: evs for tid, evs in traces.items()
+                    if any(ev["name"] == "trace.retry" for ev in evs)}
+    stitched = 0
+    for tid, evs in retry_traces.items():
+        workers = {ev["args"].get("worker") for ev in evs
+                   if ev["name"] == "trace.route"}
+        errored = any(ev["name"] == "trace.request"
+                      and ev["args"].get("error") for ev in evs)
+        served = any(ev["name"] == "trace.request"
+                     and not ev["args"].get("error") for ev in evs)
+        if len(workers) >= 2 and errored and served:
+            stitched += 1
+    if not retry_traces:
+        problems.append("no trace carries a trace.retry span")
+    elif stitched == 0:
+        problems.append("retry traces exist but none stitches both "
+                        "attempts (route spans on 2 workers + errored "
+                        "and served request spans) under one id")
+    if kill_tids and not (set(retry_traces) & set(kill_tids)):
+        problems.append("retry spans landed outside the kill-phase "
+                        "trace ids")
+
+    verdict = {
+        "gate": "serve_retry",
+        "fleet": fleet,
+        "traces": {"total": len(traces), "retry": len(retry_traces),
+                   "stitched": stitched},
+        "sink": sink,
+        "ok": not problems,
+    }
+    if problems:
+        verdict["problems"] = problems
+    print(json.dumps(verdict))
+    return 0 if not problems else 1
+
+
 def main():
     parser = argparse.ArgumentParser("chaos_run")
     parser.add_argument("--worker", action="store_true",
@@ -707,6 +913,13 @@ def main():
                              "recovery path — restart, shrink, replay — "
                              "must keep fault-free parity with the "
                              "sharded state migrating across meshes")
+    parser.add_argument("--serve-retry", action="store_true",
+                        help="run the in-process serving-fleet gate "
+                             "instead of the training gang: kill a "
+                             "fleet worker mid-flight and assert hedged "
+                             "retries answer correctly under one "
+                             "stitched trace, the sick worker's breaker "
+                             "trips, and a half-open probe recovers it")
     parser.add_argument("--check-parity", action="store_true",
                         default=True)
     parser.add_argument("--no-check-parity", dest="check_parity",
@@ -728,6 +941,8 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if args.serve_retry:
+        return run_serve_retry(args)
     return run_supervisor(args)
 
 

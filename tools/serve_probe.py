@@ -397,6 +397,194 @@ def probe_autoscale(args):
     return 0
 
 
+def probe_overload(args):
+    """Overload-protection acceptance gate (--overload): drive the
+    server at 4x its measured capacity for a sustained window with the
+    admission stack armed (bounded queue + deadlines + priority
+    shedding) and assert the three graceful-degradation invariants:
+
+    * bounded — the queue depth never exceeds PADDLE_TPU_QUEUE_LIMIT
+      (sampled throughout the overload phase);
+    * conserved — every submission is accounted for exactly once:
+      served, ``Rejected`` (queue_full / predicted_late / shed), or
+      ``DeadlineExceeded``; zero futures are left unresolved;
+    * useful — the p99 of ADMITTED-and-served requests stays within
+      the SLO. That is the entire point of shedding: the requests you
+      do serve stay fast, instead of everyone timing out together.
+
+    Requests carry a queueing deadline of 0.6x the SLO — the deadline
+    bounds time-in-queue (checked when the batcher collects), so the
+    client budget must leave headroom for the batch compute that
+    happens after admission — and a mixed priority population (1 in 4
+    high); low-priority traffic is what the gate sheds first once the
+    burn monitor trips.
+    """
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import DeadlineExceeded, Rejected
+    from paddle_tpu.observability.health import SloMonitor
+
+    queue_limit = args.queue_limit
+    obs.set_enabled(True)
+    flags.set_flags({"metrics": True, "queue_limit": queue_limit,
+                     "serving_shed": True})
+    try:
+        # probe-scale burn windows (seconds, not SRE minutes) so the
+        # shedding story fits in CI time; slo_ms is tightened after
+        # calibration (read at record time)
+        mon = SloMonitor(10000.0, target=0.9, fast_window_s=1.0,
+                         slow_window_s=30.0, fast_burn=1.5,
+                         slow_burn=3.0, name="overload")
+        server, one_row, _ = build_server(
+            args.model, int8=args.int8,
+            calib_batches=args.calib_batches,
+            buckets=args.buckets or "1,2,4",
+            max_wait_ms=args.max_wait_ms, seed=args.seed,
+            slo_monitor=mon)
+        rng = np.random.RandomState(args.seed)
+        with server:
+            server.warmup(one_row())
+            # -- calibrate: single-row p50 and full-bucket batch time
+            lat = []
+            for _ in range(20):
+                t0 = time.monotonic()
+                server.run(one_row())
+                lat.append((time.monotonic() - t0) * 1000.0)
+            p50 = float(np.median(lat))
+            slo_ms = args.serving_slo_ms or max(50.0, 10.0 * p50)
+            mon.slo_ms = slo_ms
+            top = server.buckets[-1]
+            t0 = time.monotonic()
+            for _ in range(3):
+                server.run({k: server._tile(np.asarray(v), top)
+                            for k, v in one_row().items()})
+            batch_ms = (time.monotonic() - t0) * 1000.0 / 3.0
+            # honest capacity of the coalescing batcher: a full top
+            # bucket per batch
+            cap_qps = top / max(batch_ms, 1e-3) * 1000.0
+
+            # -- sustained overload at 4x capacity (escalating once if
+            # CPU timing noise swallowed the pressure)
+            duration = max(4.0, 2.0 * args.duration)
+            outcome = None
+            for mult in (4.0, 16.0):
+                qps = mult * cap_qps
+                served, shed, expired = [], 0, 0
+                rejected = {"queue_full": 0, "predicted_late": 0,
+                            "shed": 0}
+                futures, depth_max, unresolved, other = [], 0, 0, []
+                t_start = time.monotonic()
+                t_end = t_start + duration
+                nxt = t_start
+                i = 0
+                while True:
+                    nxt += rng.exponential(1.0 / qps)
+                    if nxt >= t_end:
+                        break
+                    d = nxt - time.monotonic()
+                    if d > 0:
+                        time.sleep(d)
+                    pri = 1 if i % 4 == 0 else 0
+                    i += 1
+                    try:
+                        futures.append(server.submit(
+                            one_row(), deadline_ms=0.6 * slo_ms,
+                            priority=pri))
+                    except Rejected as e:
+                        rejected[e.reason] = rejected.get(e.reason,
+                                                          0) + 1
+                    if i % 8 == 0:
+                        depth_max = max(depth_max,
+                                        server.health()["queue_depth"])
+                submitted = i
+                # -- drain: every future must resolve, each into
+                # exactly one bucket
+                for f in futures:
+                    try:
+                        f.result(timeout=120)
+                        served.append((f.t_done - f.t_enq) * 1000.0)
+                    except DeadlineExceeded:
+                        expired += 1
+                    except Rejected:
+                        shed += 1        # evicted from the queue
+                    except Exception as e:  # noqa: BLE001
+                        if f.done():
+                            other.append(repr(e)[:120])
+                        else:
+                            unresolved += 1
+                turned_away = (sum(rejected.values()) + shed + expired)
+                outcome = {
+                    "mult": mult, "offered_qps": round(qps, 1),
+                    "submitted": submitted, "served": len(served),
+                    "rejected": rejected, "shed_evicted": shed,
+                    "expired": expired, "unresolved": unresolved,
+                    "other_errors": other, "depth_max": depth_max,
+                    "served_p99_ms": (round(float(np.percentile(
+                        served, 99)), 2) if served else None),
+                }
+                if turned_away > 0:
+                    break               # real pressure reached
+            health = server.health()
+        counters = {k: obs.counter_value("serving." + k) for k in
+                    ("requests", "rejected", "shed", "expired")}
+    finally:
+        for name in ("queue_limit", "serving_shed", "metrics"):
+            flags.reset_flag(name)
+        obs.set_enabled(None)
+
+    problems = []
+    accounted = (outcome["served"] + sum(outcome["rejected"].values())
+                 + outcome["shed_evicted"] + outcome["expired"])
+    if accounted != outcome["submitted"] or outcome["other_errors"]:
+        problems.append(
+            "conservation broken: submitted %d != served %d + rejected "
+            "%s + shed %d + expired %d (other: %s)"
+            % (outcome["submitted"], outcome["served"],
+               outcome["rejected"], outcome["shed_evicted"],
+               outcome["expired"], outcome["other_errors"]))
+    if outcome["unresolved"]:
+        problems.append("%d future(s) left unresolved"
+                        % outcome["unresolved"])
+    if outcome["depth_max"] > queue_limit:
+        problems.append("queue depth %d exceeded the %d limit"
+                        % (outcome["depth_max"], queue_limit))
+    turned_away = (sum(outcome["rejected"].values())
+                   + outcome["shed_evicted"] + outcome["expired"])
+    if turned_away == 0:
+        problems.append("no request was ever shed/rejected/expired — "
+                        "the overload never pressured the gate "
+                        "(offered %.0f qps)" % outcome["offered_qps"])
+    if outcome["served"] == 0:
+        problems.append("overload served nothing at all — shedding "
+                        "must preserve goodput, not replace it")
+    elif (outcome["served_p99_ms"] is not None
+            and outcome["served_p99_ms"] > slo_ms):
+        problems.append("admitted-request p99 %.1fms blew the %.1fms "
+                        "SLO despite shedding"
+                        % (outcome["served_p99_ms"], slo_ms))
+
+    verdict = {
+        "slo_ms": round(slo_ms, 2),
+        "baseline_p50_ms": round(p50, 2),
+        "capacity_qps": round(cap_qps, 1),
+        "queue_limit": queue_limit,
+        "overload": outcome,
+        "health": {"healthy": health["healthy"],
+                   "queue_depth": health["queue_depth"]},
+        "counters": counters,
+        "problems": problems,
+        "ok": not problems,
+    }
+    print(json.dumps(verdict))
+    if problems:
+        sys.stderr.write("serving overload gate failed:\n  - "
+                         + "\n  - ".join(problems) + "\n")
+        return 1
+    return 0
+
+
 def probe_trace(args):
     """Request-tracing acceptance gate (--trace): under the Poisson
     sweep, every over-SLO request must have produced a KEPT trace in
@@ -630,6 +818,15 @@ def main(argv=None):
                          "under the SLO with zero dropped requests")
     ap.add_argument("--fleet-max", type=int, default=3,
                     help="FleetRouter max_workers for --autoscale")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the overload-protection gate: 4x "
+                         "sustained overload with admission control "
+                         "armed; asserts bounded queue, exact "
+                         "served/rejected/expired conservation, and "
+                         "admitted-request p99 within the SLO")
+    ap.add_argument("--queue-limit", type=int, default=32,
+                    help="PADDLE_TPU_QUEUE_LIMIT used by --overload "
+                         "(default 32)")
     ap.add_argument("--trace", action="store_true",
                     help="request-tracing gate: every over-SLO request "
                          "under a 2x-capacity Poisson load must leave "
@@ -642,6 +839,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.autoscale:
         return probe_autoscale(args)
+    if args.overload:
+        return probe_overload(args)
     if args.trace:
         return probe_trace(args)
     if args.check_health and args.serving_slo_ms is None:
